@@ -1,0 +1,175 @@
+"""pathway_tpu — a TPU-native incremental streaming dataflow framework.
+
+A ground-up reimplementation of the capabilities of Pathway (the Python+Rust
+incremental dataflow engine; reference layout in ``SURVEY.md``) designed for
+JAX/XLA/TPU: relational operators run vectorized over columnar delta blocks, ML
+compute (embedders, rerankers, KNN search) runs as jitted JAX on TPU with microbatched
+UDF dispatch, and distribution uses ``jax.sharding`` meshes over ICI/DCN.
+
+Use it like the reference::
+
+    import pathway_tpu as pw
+
+    class InputSchema(pw.Schema):
+        value: int
+
+    t = pw.debug.table_from_markdown('''
+    value
+    1
+    2''')
+    result = t.reduce(total=pw.reducers.sum(pw.this.value))
+    pw.debug.compute_and_print(result)
+"""
+
+from __future__ import annotations
+
+# core dtypes / schema -------------------------------------------------------
+from pathway_tpu.internals import dtype as _dt
+from pathway_tpu.internals.dtype import DateTimeNaive, DateTimeUtc, Duration, Pointer
+from pathway_tpu.internals.json import Json
+from pathway_tpu.internals.schema import (
+    ColumnDefinition,
+    Schema,
+    column_definition,
+    schema_from_csv,
+    schema_from_dict,
+    schema_from_pandas,
+    schema_from_types,
+)
+
+# expressions ----------------------------------------------------------------
+from pathway_tpu.internals.expression import (
+    ColumnExpression,
+    ColumnReference,
+    apply,
+    apply_async,
+    apply_with_type,
+    cast,
+    coalesce,
+    declare_type,
+    fill_error,
+    if_else,
+    make_tuple,
+    require,
+    unwrap,
+)
+from pathway_tpu.internals.thisclass import left, right, this
+
+# tables ---------------------------------------------------------------------
+from pathway_tpu.internals.table import Table
+from pathway_tpu.internals.groupbys import GroupedTable
+from pathway_tpu.internals.joins import JoinResult
+
+# reducers / udfs ------------------------------------------------------------
+from pathway_tpu.internals import reducers
+from pathway_tpu.internals.reducers import BaseCustomAccumulator
+from pathway_tpu.internals.udfs import (
+    UDF,
+    AsyncExecutor,
+    CacheStrategy,
+    DefaultCache,
+    DiskCache,
+    ExponentialBackoffRetryStrategy,
+    FixedDelayRetryStrategy,
+    FullyAsyncExecutor,
+    InMemoryCache,
+    SyncExecutor,
+    async_executor,
+    fully_async_executor,
+    udf,
+)
+
+# run ------------------------------------------------------------------------
+from pathway_tpu.internals.run import MonitoringLevel, run, run_all
+from pathway_tpu.internals.parse_graph import G
+
+# subpackages ----------------------------------------------------------------
+from pathway_tpu import debug, demo, io, persistence, stdlib, universes
+from pathway_tpu.stdlib import temporal, indexing, ml, graphs, statistical, utils as _stdlib_utils
+from pathway_tpu.internals.iterate import iterate, iterate_universe
+
+# commonly used temporal entry points at top level (parity with reference) ---
+from pathway_tpu.internals.errors import ERROR as _ERROR
+
+PENDING = None  # replaced below to avoid import cycle surprises
+from pathway_tpu.internals.errors import PENDING  # noqa: E402,F811
+
+__version__ = "0.1.0"
+
+Table = Table  # re-exported
+
+
+def global_error_log():
+    from pathway_tpu.internals.error_log import global_error_log as _gel
+
+    return _gel()
+
+
+def sql(query: str, **tables):
+    from pathway_tpu.internals.sql import sql as _sql
+
+    return _sql(query, **tables)
+
+
+def enable_interactive_mode() -> None:
+    raise NotImplementedError("interactive mode is not available yet")
+
+
+def set_license_key(key: str | None) -> None:
+    pass  # no license enforcement in the TPU build (reference: src/engine/license.rs)
+
+
+def set_monitoring_config(*, server_endpoint: str | None = None) -> None:
+    pass
+
+
+__all__ = [
+    "Table",
+    "Schema",
+    "ColumnDefinition",
+    "ColumnExpression",
+    "ColumnReference",
+    "GroupedTable",
+    "JoinResult",
+    "Json",
+    "Pointer",
+    "DateTimeNaive",
+    "DateTimeUtc",
+    "Duration",
+    "MonitoringLevel",
+    "UDF",
+    "BaseCustomAccumulator",
+    "apply",
+    "apply_async",
+    "apply_with_type",
+    "cast",
+    "coalesce",
+    "column_definition",
+    "declare_type",
+    "fill_error",
+    "if_else",
+    "iterate",
+    "left",
+    "make_tuple",
+    "reducers",
+    "require",
+    "right",
+    "run",
+    "run_all",
+    "schema_from_csv",
+    "schema_from_dict",
+    "schema_from_pandas",
+    "schema_from_types",
+    "sql",
+    "this",
+    "udf",
+    "unwrap",
+    "debug",
+    "demo",
+    "io",
+    "persistence",
+    "stdlib",
+    "temporal",
+    "indexing",
+    "universes",
+]

@@ -1,0 +1,203 @@
+"""Columnar delta blocks — the engine's unit of data.
+
+The reference streams per-record ``(key, tuple, time, diff)`` updates through
+differential operators (``src/engine/dataflow.rs``). That shape is hostile to XLA, so
+per SURVEY §7.1.1 the TPU engine's unit is a **delta block**: aligned uint64 key
+array, int64 diff (±weight) array, and a dict of columnar value arrays, all sharing a
+logical timestamp. Relational kernels are vectorized over whole blocks;
+consolidation is a sort + segmented reduction over (key, row-digest).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Iterable, Mapping
+
+import numpy as np
+
+from pathway_tpu.internals import dtype as dt
+from pathway_tpu.internals.keys import hash_column, row_keys, splitmix64
+
+
+class DeltaBatch:
+    __slots__ = ("keys", "diffs", "data", "time")
+
+    def __init__(
+        self,
+        keys: np.ndarray,
+        diffs: np.ndarray,
+        data: Mapping[str, np.ndarray],
+        time: int,
+    ):
+        self.keys = np.asarray(keys, dtype=np.uint64)
+        self.diffs = np.asarray(diffs, dtype=np.int64)
+        self.data = dict(data)
+        self.time = time
+        n = len(self.keys)
+        assert len(self.diffs) == n, "diffs misaligned"
+        for name, col in self.data.items():
+            assert len(col) == n, f"column {name!r} misaligned: {len(col)} != {n}"
+
+    def __len__(self) -> int:
+        return len(self.keys)
+
+    def __repr__(self) -> str:
+        return f"DeltaBatch(n={len(self)}, t={self.time}, cols={list(self.data)})"
+
+    @property
+    def is_empty(self) -> bool:
+        return len(self.keys) == 0
+
+    def take(self, idx: np.ndarray) -> "DeltaBatch":
+        return DeltaBatch(
+            self.keys[idx],
+            self.diffs[idx],
+            {n: c[idx] for n, c in self.data.items()},
+            self.time,
+        )
+
+    def with_data(self, data: Mapping[str, np.ndarray]) -> "DeltaBatch":
+        return DeltaBatch(self.keys, self.diffs, data, self.time)
+
+    def with_keys(self, keys: np.ndarray) -> "DeltaBatch":
+        return DeltaBatch(keys, self.diffs, self.data, self.time)
+
+    def select_columns(self, names: Iterable[str]) -> "DeltaBatch":
+        return DeltaBatch(self.keys, self.diffs, {n: self.data[n] for n in names}, self.time)
+
+    def negated(self) -> "DeltaBatch":
+        return DeltaBatch(self.keys, -self.diffs, self.data, self.time)
+
+    def rows(self) -> Iterable[tuple[np.uint64, int, tuple]]:
+        cols = list(self.data.values())
+        for i in range(len(self.keys)):
+            yield self.keys[i], int(self.diffs[i]), tuple(c[i] for c in cols)
+
+    def row_digest(self) -> np.ndarray:
+        """uint64 digest of each row's values (keys excluded)."""
+        n = len(self.keys)
+        h = np.zeros(n, dtype=np.uint64)
+        for name in sorted(self.data):
+            with np.errstate(over="ignore"):
+                h = splitmix64(h * np.uint64(0x100000001B3) ^ hash_column(self.data[name]))
+        return h
+
+    @staticmethod
+    def empty(columns: Iterable[str], time: int) -> "DeltaBatch":
+        return DeltaBatch(
+            np.empty(0, dtype=np.uint64),
+            np.empty(0, dtype=np.int64),
+            {c: np.empty(0, dtype=object) for c in columns},
+            time,
+        )
+
+    @staticmethod
+    def from_rows(
+        keys: Iterable[Any],
+        rows: Iterable[tuple],
+        columns: list[str],
+        time: int,
+        diffs: Iterable[int] | None = None,
+        np_dtypes: Mapping[str, np.dtype] | None = None,
+    ) -> "DeltaBatch":
+        keys_arr = np.fromiter((np.uint64(k) for k in keys), dtype=np.uint64)
+        n = len(keys_arr)
+        rows = list(rows)
+        data: dict[str, np.ndarray] = {}
+        for j, name in enumerate(columns):
+            npd = (np_dtypes or {}).get(name, np.dtype(object))
+            data[name] = make_column([r[j] for r in rows], npd)
+        diffs_arr = (
+            np.ones(n, dtype=np.int64)
+            if diffs is None
+            else np.fromiter(diffs, dtype=np.int64, count=n)
+        )
+        return DeltaBatch(keys_arr, diffs_arr, data, time)
+
+
+def make_column(values: list, np_dtype: np.dtype) -> np.ndarray:
+    """Build a column array of the schema's storage dtype, falling back to object
+    when values don't fit (None in an int column, etc.)."""
+    if np_dtype == np.dtype(object):
+        arr = np.empty(len(values), dtype=object)
+        arr[:] = values
+        return arr
+    try:
+        if any(v is None for v in values):
+            if np_dtype.kind == "f":
+                return np.asarray(
+                    [np.nan if v is None else v for v in values], dtype=np_dtype
+                )
+            if np_dtype.kind in ("M", "m"):
+                return np.asarray(
+                    [np.datetime64("NaT") if v is None else v for v in values], dtype=np_dtype
+                )
+            arr = np.empty(len(values), dtype=object)
+            arr[:] = values
+            return arr
+        return np.asarray(values, dtype=np_dtype)
+    except (TypeError, ValueError):
+        arr = np.empty(len(values), dtype=object)
+        arr[:] = values
+        return arr
+
+
+def concat_batches(batches: list[DeltaBatch]) -> DeltaBatch | None:
+    batches = [b for b in batches if not b.is_empty]
+    if not batches:
+        return None
+    if len(batches) == 1:
+        return batches[0]
+    time = batches[-1].time
+    keys = np.concatenate([b.keys for b in batches])
+    diffs = np.concatenate([b.diffs for b in batches])
+    names = batches[0].data.keys()
+    data = {}
+    for n in names:
+        cols = [b.data[n] for b in batches]
+        if all(c.dtype == cols[0].dtype for c in cols):
+            data[n] = np.concatenate(cols)
+        else:
+            merged = np.empty(len(keys), dtype=object)
+            ofs = 0
+            for c in cols:
+                merged[ofs : ofs + len(c)] = c
+                ofs += len(c)
+            data[n] = merged
+    return DeltaBatch(keys, diffs, data, time)
+
+
+def consolidate(batch: DeltaBatch) -> DeltaBatch:
+    """Sum diffs per (key, row-digest); drop rows with net diff 0.
+
+    The block analogue of differential's arrangement consolidation.
+    """
+    if len(batch) <= 1:
+        if len(batch) == 1 and batch.diffs[0] == 0:
+            return batch.take(np.empty(0, dtype=np.int64))
+        return batch
+    digests = batch.row_digest()
+    order = np.lexsort((digests, batch.keys))
+    k = batch.keys[order]
+    d = digests[order]
+    boundaries = np.empty(len(k), dtype=bool)
+    boundaries[0] = True
+    boundaries[1:] = (k[1:] != k[:-1]) | (d[1:] != d[:-1])
+    group_starts = np.flatnonzero(boundaries)
+    sums = np.add.reduceat(batch.diffs[order], group_starts)
+    keep = sums != 0
+    idx = order[group_starts[keep]]
+    out = batch.take(idx)
+    out.diffs = sums[keep].astype(np.int64)
+    return out
+
+
+def apply_diffs_to_state(state: dict, batch: DeltaBatch) -> None:
+    """Fold a delta batch into a key→row-tuple dict (last-write-wins per key,
+    respecting diffs: -1 removes, +1 inserts)."""
+    cols = list(batch.data.values())
+    for i in range(len(batch.keys)):
+        k = int(batch.keys[i])
+        if batch.diffs[i] > 0:
+            state[k] = tuple(c[i] for c in cols)
+        else:
+            state.pop(k, None)

@@ -1,0 +1,150 @@
+"""Engine dataflow graph and the tick scheduler.
+
+Role of the reference's worker main loop (``src/engine/dataflow.rs:6202-6255``:
+``loop { probers; flushers; pollers; worker.step_or_park }``): a topologically-ordered
+DAG of engine nodes processes **delta blocks** tick by tick. Each logical timestamp is
+one tick; within a tick the scheduler sweeps nodes in topo order until quiescent, then
+advances the frontier (notifying temporal operators: buffers, forget, windows), then
+sweeps again — so all downstream consequences of a timestamp are drained before the
+next timestamp starts, giving the reference's "every output reflects a known prefix of
+inputs" consistency model.
+"""
+
+from __future__ import annotations
+
+import time as _time
+from typing import Callable
+
+import numpy as np
+
+from pathway_tpu.engine.blocks import DeltaBatch, concat_batches
+
+END_OF_STREAM = np.iinfo(np.int64).max  # frontier value after all input closed
+
+
+class Node:
+    """Engine operator. Subclasses implement ``process`` and optionally
+    ``on_frontier``."""
+
+    name: str = "node"
+
+    def __init__(self, n_inputs: int = 1):
+        self.n_inputs = n_inputs
+        self.node_index: int = -1  # set by EngineGraph
+        self._buffers: list[list[DeltaBatch]] = [[] for _ in range(n_inputs)]
+        self.stats_rows_in = 0
+        self.stats_rows_out = 0
+        self.stats_time_ns = 0
+
+    # -- scheduler interface --
+    def accept(self, port: int, batch: DeltaBatch) -> None:
+        if not batch.is_empty:
+            self._buffers[port].append(batch)
+
+    def has_pending(self) -> bool:
+        return any(self._buffers)
+
+    def drain(self) -> list[DeltaBatch | None]:
+        out: list[DeltaBatch | None] = []
+        for port in range(self.n_inputs):
+            out.append(concat_batches(self._buffers[port]))
+            self._buffers[port] = []
+        return out
+
+    # -- operator interface --
+    def poll(self, time: int) -> list[DeltaBatch]:
+        """Called at tick start; source nodes emit their pending input here."""
+        return []
+
+    def process(self, inputs: list[DeltaBatch | None], time: int) -> list[DeltaBatch]:
+        """Consume one round of input batches, return emissions (all at ``time``)."""
+        return []
+
+    def on_frontier(self, time: int) -> list[DeltaBatch]:
+        """Called when the frontier passes ``time`` (end of tick). May emit."""
+        return []
+
+    def on_end(self) -> None:
+        """Stream closed — release resources, fire final callbacks."""
+
+
+class EngineGraph:
+    def __init__(self) -> None:
+        self.nodes: list[Node] = []
+        # edges[i] = list of (consumer_index, port)
+        self.edges: dict[int, list[tuple[int, int]]] = {}
+
+    def add_node(self, node: Node, inputs: list[Node]) -> Node:
+        node.node_index = len(self.nodes)
+        self.nodes.append(node)
+        assert len(inputs) == node.n_inputs, f"{node.name}: wrong input arity"
+        for port, src in enumerate(inputs):
+            assert src.node_index >= 0 and src.node_index < node.node_index, (
+                f"{node.name}: inputs must be added before consumers (topo order)"
+            )
+            self.edges.setdefault(src.node_index, []).append((node.node_index, port))
+        return node
+
+
+class Scheduler:
+    """Drives the engine graph tick by tick."""
+
+    def __init__(self, graph: EngineGraph):
+        self.graph = graph
+        self.current_time = 0
+        self.on_tick_done: list[Callable[[int], None]] = []
+
+    def _route(self, producer: Node, batches: list[DeltaBatch]) -> bool:
+        routed = False
+        consumers = self.graph.edges.get(producer.node_index, [])
+        for batch in batches:
+            if batch is None or batch.is_empty:
+                continue
+            producer.stats_rows_out += len(batch)
+            for ci, port in consumers:
+                self.graph.nodes[ci].accept(port, batch)
+                routed = True
+        return routed
+
+    def _sweep(self, time: int) -> bool:
+        """One topo pass; returns True if any node did work."""
+        any_work = False
+        for node in self.graph.nodes:
+            if not node.has_pending():
+                continue
+            inputs = node.drain()
+            node.stats_rows_in += sum(len(b) for b in inputs if b is not None)
+            t0 = _time.perf_counter_ns()
+            out = node.process(inputs, time)
+            node.stats_time_ns += _time.perf_counter_ns() - t0
+            self._route(node, out)
+            any_work = True
+        return any_work
+
+    def run_tick(self, time: int) -> None:
+        """Process everything pending at logical ``time`` to quiescence, then
+        advance the frontier past it."""
+        self.current_time = time
+        for node in self.graph.nodes:
+            self._route(node, node.poll(time))
+        while self._sweep(time):
+            pass
+        # frontier phase: notify in topo order; emissions re-enter the same tick
+        progressed = True
+        while progressed:
+            progressed = False
+            for node in self.graph.nodes:
+                out = node.on_frontier(time)
+                if self._route(node, out):
+                    progressed = True
+            if progressed:
+                while self._sweep(time):
+                    pass
+        for cb in self.on_tick_done:
+            cb(time)
+
+    def close(self) -> None:
+        """Input exhausted: flush temporal buffers and fire end callbacks."""
+        self.run_tick(END_OF_STREAM)
+        for node in self.graph.nodes:
+            node.on_end()

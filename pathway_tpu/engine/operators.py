@@ -1,0 +1,729 @@
+"""Engine operator nodes.
+
+Block-oriented counterparts of the reference's dataflow operators
+(``src/engine/dataflow.rs`` lowering of the ``Graph`` trait,
+``src/engine/graph.rs:647-1015``): rowwise map/filter/reindex are stateless block
+kernels; group-by keeps per-group accumulators (``reduce.rs`` styles); combine covers
+update_rows/update_cells/restrict/intersect/difference/having; join is an incremental
+symmetric hash join with outer-padding accounting; flatten explodes sequence columns.
+All state lives keyed by uint64 row keys, diffs are ±weights.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import defaultdict
+from typing import Any, Callable, Iterable
+
+import numpy as np
+
+from pathway_tpu.engine.blocks import DeltaBatch, consolidate, make_column
+from pathway_tpu.engine.graph import END_OF_STREAM, Node
+from pathway_tpu.engine.reducers_impl import ReducerImpl
+from pathway_tpu.internals.keys import combine_keys, row_keys, splitmix64
+
+# ---------------------------------------------------------------------------- inputs
+
+
+class StaticInputNode(Node):
+    name = "static_input"
+
+    def __init__(self, batch_factory: Callable[[int], DeltaBatch]):
+        super().__init__(n_inputs=0)
+        self.batch_factory = batch_factory
+        self._emitted = False
+
+    def poll(self, time: int) -> list[DeltaBatch]:
+        if self._emitted or time == END_OF_STREAM:
+            return []
+        self._emitted = True
+        return [self.batch_factory(time)]
+
+
+class StreamInputNode(Node):
+    """Receives events from connector threads via a lock-protected queue.
+
+    The engine-side half of the reference's connector loop
+    (``src/connectors/mod.rs:91`` + ``adaptors.rs:20-47`` InputSession/UpsertSession):
+    events accumulate between ticks; ``poll`` drains them as one delta block per tick.
+    ``upsert=True`` gives UpsertSession semantics: a new row for an existing key
+    retracts the previous one; value ``None`` deletes.
+    """
+
+    name = "stream_input"
+
+    def __init__(self, columns: list[str], np_dtypes: dict | None = None, upsert: bool = False):
+        super().__init__(n_inputs=0)
+        self.columns = columns
+        self.np_dtypes = np_dtypes or {}
+        self.upsert = upsert
+        self._lock = threading.Lock()
+        self._pending: list[tuple[int, tuple | None, int]] = []  # (key, values, diff)
+        self._state: dict[int, tuple] = {}  # upsert sessions remember current row
+
+    # called from connector threads
+    def push(self, key: int, values: tuple | None, diff: int = 1) -> None:
+        with self._lock:
+            self._pending.append((int(key), values, diff))
+
+    def push_many(self, events: Iterable[tuple[int, tuple | None, int]]) -> None:
+        with self._lock:
+            self._pending.extend(events)
+
+    def poll(self, time: int) -> list[DeltaBatch]:
+        with self._lock:
+            pending, self._pending = self._pending, []
+        if not pending or time == END_OF_STREAM:
+            return []
+        keys: list[int] = []
+        diffs: list[int] = []
+        rows: list[tuple] = []
+        for key, values, diff in pending:
+            if self.upsert:
+                old = self._state.get(key)
+                if old is not None:
+                    keys.append(key)
+                    diffs.append(-1)
+                    rows.append(old)
+                if values is not None and diff > 0:
+                    keys.append(key)
+                    diffs.append(1)
+                    rows.append(values)
+                    self._state[key] = values
+                elif key in self._state:
+                    del self._state[key]
+            else:
+                if values is None:
+                    continue
+                keys.append(key)
+                diffs.append(diff)
+                rows.append(values)
+        if not keys:
+            return []
+        batch = DeltaBatch.from_rows(
+            keys, rows, self.columns, time, diffs=diffs, np_dtypes=self.np_dtypes
+        )
+        return [consolidate(batch)]
+
+
+# ---------------------------------------------------------------------------- rowwise
+
+
+class RowwiseNode(Node):
+    """select/with_columns: stateless block program."""
+
+    name = "rowwise"
+
+    def __init__(self, program: Callable[[DeltaBatch], dict[str, np.ndarray]]):
+        super().__init__(n_inputs=1)
+        self.program = program
+
+    def process(self, inputs, time):
+        batch = inputs[0]
+        if batch is None:
+            return []
+        return [batch.with_data(self.program(batch))]
+
+
+class FilterNode(Node):
+    name = "filter"
+
+    def __init__(self, predicate: Callable[[DeltaBatch], np.ndarray]):
+        super().__init__(n_inputs=1)
+        self.predicate = predicate
+
+    def process(self, inputs, time):
+        batch = inputs[0]
+        if batch is None:
+            return []
+        mask = self.predicate(batch)
+        if mask.dtype != np.bool_:
+            from pathway_tpu.internals.errors import ERROR
+
+            mask = np.fromiter(
+                (v is not None and v is not ERROR and bool(v) for v in mask),
+                dtype=bool,
+                count=len(mask),
+            )
+        return [batch.take(np.flatnonzero(mask))]
+
+
+class ReindexNode(Node):
+    """with_id_from / groupby key derivation: new keys from a key program."""
+
+    name = "reindex"
+
+    def __init__(self, key_program: Callable[[DeltaBatch], np.ndarray]):
+        super().__init__(n_inputs=1)
+        self.key_program = key_program
+
+    def process(self, inputs, time):
+        batch = inputs[0]
+        if batch is None:
+            return []
+        return [batch.with_keys(self.key_program(batch))]
+
+
+class SelectColumnsNode(Node):
+    name = "select_columns"
+
+    def __init__(self, columns: list[str], rename: dict[str, str] | None = None):
+        super().__init__(n_inputs=1)
+        self.columns = columns
+        self.rename = rename or {}
+
+    def process(self, inputs, time):
+        batch = inputs[0]
+        if batch is None:
+            return []
+        data = {self.rename.get(c, c): batch.data[c] for c in self.columns}
+        return [batch.with_data(data)]
+
+
+class ConcatNode(Node):
+    """Disjoint union (``concat``); with ``salts`` reindexes each side so ids
+    cannot collide (``concat_reindex``)."""
+
+    name = "concat"
+
+    def __init__(self, n_inputs: int, columns: list[str], salts: list[int] | None = None):
+        super().__init__(n_inputs=n_inputs)
+        self.columns = columns
+        self.salts = salts
+
+    def process(self, inputs, time):
+        out = []
+        for port, batch in enumerate(inputs):
+            if batch is None:
+                continue
+            batch = batch.select_columns(self.columns)
+            if self.salts is not None:
+                batch = batch.with_keys(
+                    splitmix64(batch.keys ^ np.uint64(self.salts[port]))
+                )
+            out.append(batch)
+        return out
+
+
+class FlattenNode(Node):
+    """Explode a sequence column; output keys = hash(key, index)
+    (reference: ``flatten_table``, ``src/engine/graph.rs``)."""
+
+    name = "flatten"
+
+    def __init__(self, flatten_col: str, other_cols: list[str]):
+        super().__init__(n_inputs=1)
+        self.flatten_col = flatten_col
+        self.other_cols = other_cols
+
+    def process(self, inputs, time):
+        batch = inputs[0]
+        if batch is None:
+            return []
+        keys_out: list[int] = []
+        diffs_out: list[int] = []
+        flat_vals: list[Any] = []
+        other_idx: list[int] = []
+        col = batch.data[self.flatten_col]
+        for i in range(len(batch)):
+            seq = col[i]
+            if seq is None:
+                continue
+            if isinstance(seq, np.ndarray):
+                items = list(seq)
+            elif isinstance(seq, (tuple, list, str, bytes)):
+                items = list(seq)
+            else:
+                from pathway_tpu.internals.json import Json
+
+                items = list(seq.value) if isinstance(seq, Json) else list(seq)
+            for j, item in enumerate(items):
+                keys_out.append(int(combine_keys(
+                    np.asarray([batch.keys[i]], dtype=np.uint64),
+                    splitmix64(np.asarray([j], dtype=np.uint64)),
+                )[0]))
+                diffs_out.append(int(batch.diffs[i]))
+                flat_vals.append(item)
+                other_idx.append(i)
+        data = {self.flatten_col: make_column(flat_vals, np.dtype(object))}
+        idx = np.asarray(other_idx, dtype=np.int64)
+        for c in self.other_cols:
+            data[c] = batch.data[c][idx]
+        return [
+            DeltaBatch(
+                np.asarray(keys_out, dtype=np.uint64),
+                np.asarray(diffs_out, dtype=np.int64),
+                data,
+                time,
+            )
+        ]
+
+
+# ---------------------------------------------------------------------------- groupby
+
+
+class GroupByNode(Node):
+    """Incremental grouped aggregation.
+
+    State per group: reducer accumulators + the last emitted output row; an update
+    retracts the previous aggregate row and emits the new one at the same timestamp —
+    exactly the visible behavior of the reference's ``group_by_table`` +
+    ``reduce.rs`` reducers, but driven by whole blocks with vectorized per-batch
+    partial aggregation for semigroup reducers.
+    """
+
+    name = "groupby"
+
+    def __init__(
+        self,
+        group_cols: list[str],
+        reducer_specs: list[tuple[str, ReducerImpl, list[str]]],
+        key_col: str | None = None,
+        out_group_cols: list[str] | None = None,
+    ):
+        super().__init__(n_inputs=1)
+        self.group_cols = group_cols
+        self.key_col = key_col
+        self.reducer_specs = reducer_specs
+        self.out_group_cols = out_group_cols if out_group_cols is not None else group_cols
+        # gkey -> {"g": group values tuple, "acc": [state...], "emitted": tuple|None}
+        self.state: dict[int, dict] = {}
+        self._seq = 0
+        self.out_columns = list(self.out_group_cols) + [s[0] for s in self.reducer_specs]
+
+    GLOBAL_KEY = 0x6A09E667F3BCC908  # single group for global reduce()
+
+    def _gkeys(self, batch: DeltaBatch) -> np.ndarray:
+        if self.key_col is not None:
+            return batch.data[self.key_col].astype(np.uint64)
+        if not self.group_cols:
+            return np.full(len(batch), self.GLOBAL_KEY, dtype=np.uint64)
+        return row_keys([batch.data[c] for c in self.group_cols], n=len(batch))
+
+    def process(self, inputs, time):
+        batch = inputs[0]
+        if batch is None:
+            return []
+        gkeys = self._gkeys(batch)
+        order = np.argsort(gkeys, kind="stable")
+        gk_sorted = gkeys[order]
+        boundaries = np.empty(len(gk_sorted), dtype=bool)
+        if len(gk_sorted):
+            boundaries[0] = True
+            boundaries[1:] = gk_sorted[1:] != gk_sorted[:-1]
+        starts = np.flatnonzero(boundaries)
+        ends = np.append(starts[1:], len(gk_sorted))
+
+        group_arrays = [batch.data[c] for c in self.group_cols]
+        diffs = batch.diffs
+        spec_arrays = [
+            [batch.data[c] for c in cols] for (_, _, cols) in self.reducer_specs
+        ]
+
+        out_keys: list[int] = []
+        out_diffs: list[int] = []
+        out_rows: list[tuple] = []
+
+        for s, e in zip(starts, ends):
+            idx = order[s:e]
+            gk = int(gk_sorted[s])
+            st = self.state.get(gk)
+            if st is None:
+                st = {
+                    "g": tuple(arr[idx[0]] for arr in group_arrays),
+                    "acc": [spec[1].make() for spec in self.reducer_specs],
+                    "n": 0,
+                    "emitted": None,
+                }
+                self.state[gk] = st
+            # update accumulators
+            st["n"] += int(diffs[idx].sum())
+            for r, (spec, arrays) in enumerate(zip(self.reducer_specs, spec_arrays)):
+                impl = spec[1]
+                if impl.semigroup:
+                    cols_slice = [arr[idx] for arr in arrays]
+                    partial = impl.batch_partial(cols_slice, diffs[idx], slice(None))
+                    st["acc"][r] = impl.merge_partial(st["acc"][r], partial)
+                else:
+                    for i in idx:
+                        st["acc"][r] = (
+                            impl.update(
+                                st["acc"][r],
+                                tuple(arr[i] for arr in arrays),
+                                int(diffs[i]),
+                                time,
+                                self._seq,
+                            )
+                            or st["acc"][r]
+                        )
+                        self._seq += 1
+            # emit
+            old = st["emitted"]
+            if st["n"] <= 0:
+                new = None
+                del self.state[gk]
+            else:
+                g_vals = st["g"][: len(self.out_group_cols)]
+                new = g_vals + tuple(
+                    spec[1].extract(st["acc"][r])
+                    for r, spec in enumerate(self.reducer_specs)
+                )
+                st["emitted"] = new
+            if old == new and not _tuple_differs(old, new):
+                continue
+            if old is not None:
+                out_keys.append(gk)
+                out_diffs.append(-1)
+                out_rows.append(old)
+            if new is not None:
+                out_keys.append(gk)
+                out_diffs.append(1)
+                out_rows.append(new)
+
+        if not out_keys:
+            return []
+        return [
+            DeltaBatch.from_rows(out_keys, out_rows, self.out_columns, time, diffs=out_diffs)
+        ]
+
+
+def _tuple_differs(a, b) -> bool:
+    if (a is None) != (b is None):
+        return True
+    if a is None:
+        return False
+    if len(a) != len(b):
+        return True
+    for x, y in zip(a, b):
+        if isinstance(x, np.ndarray) or isinstance(y, np.ndarray):
+            if not np.array_equal(x, y):
+                return True
+        elif x != y:
+            return True
+    return False
+
+
+# ---------------------------------------------------------------------------- combine
+
+
+class SideSpec:
+    __slots__ = ("required", "negated")
+
+    def __init__(self, required: bool = True, negated: bool = False):
+        self.required = required
+        self.negated = negated
+
+
+class CombineNode(Node):
+    """Key-aligned N-way combine.
+
+    One node covers the reference's same-universe operator family:
+    ``update_rows``/``update_cells`` (override semantics), ``restrict``/
+    ``intersect`` (required sides), ``difference`` (negated side), ``having``,
+    and cross-table rowwise selects over equal universes.
+    """
+
+    name = "combine"
+
+    def __init__(
+        self,
+        sides: list[SideSpec],
+        side_columns: list[list[str]],
+        combine_fn: Callable[[int, list[tuple | None]], tuple | None],
+        out_columns: list[str],
+        np_dtypes: dict | None = None,
+    ):
+        super().__init__(n_inputs=len(sides))
+        self.sides = sides
+        self.side_columns = side_columns
+        self.combine_fn = combine_fn
+        self.out_columns = out_columns
+        self.np_dtypes = np_dtypes or {}
+        self.side_state: list[dict[int, tuple]] = [dict() for _ in sides]
+        self.emitted: dict[int, tuple] = {}
+
+    def process(self, inputs, time):
+        affected: set[int] = set()
+        for port, batch in enumerate(inputs):
+            if batch is None:
+                continue
+            state = self.side_state[port]
+            cols = [batch.data[c] for c in self.side_columns[port]]
+            for i in range(len(batch)):
+                k = int(batch.keys[i])
+                if batch.diffs[i] > 0:
+                    state[k] = tuple(c[i] for c in cols)
+                else:
+                    state.pop(k, None)
+                affected.add(k)
+        out_keys: list[int] = []
+        out_diffs: list[int] = []
+        out_rows: list[tuple] = []
+        for k in affected:
+            rows = [st.get(k) for st in self.side_state]
+            present = True
+            for spec, row in zip(self.sides, rows):
+                has = row is not None
+                if spec.negated:
+                    has = not has
+                if spec.required and not has:
+                    present = False
+                    break
+            new = self.combine_fn(k, rows) if present else None
+            old = self.emitted.get(k)
+            if not _tuple_differs(old, new):
+                continue
+            if old is not None:
+                out_keys.append(k)
+                out_diffs.append(-1)
+                out_rows.append(old)
+                del self.emitted[k]
+            if new is not None:
+                out_keys.append(k)
+                out_diffs.append(1)
+                out_rows.append(new)
+                self.emitted[k] = new
+        if not out_keys:
+            return []
+        return [
+            DeltaBatch.from_rows(
+                out_keys, out_rows, self.out_columns, time,
+                diffs=out_diffs, np_dtypes=self.np_dtypes,
+            )
+        ]
+
+
+# ---------------------------------------------------------------------------- join
+
+
+class JoinNode(Node):
+    """Incremental symmetric hash equi-join with outer padding.
+
+    The block counterpart of ``join_tables`` (``src/engine/graph.rs:783`` region):
+    per-side state maps join-key → {row_key → values}; a delta on one side joins
+    against the other side's state. For outer variants, per join-key match counts
+    decide when unmatched (null-padded) rows appear/disappear; output row keys are
+    ``hash(left_key, right_key)`` (padded rows: hash with a side salt), matching the
+    reference's id-from-both-sides discipline.
+    """
+
+    name = "join"
+
+    def __init__(
+        self,
+        left_cols: list[str],
+        right_cols: list[str],
+        left_on: str,
+        right_on: str,
+        how: str = "inner",  # inner | left | right | outer
+        out_columns: list[str] | None = None,
+        left_id_only: bool = False,
+        np_dtypes: dict | None = None,
+    ):
+        super().__init__(n_inputs=2)
+        self.left_cols = left_cols
+        self.right_cols = right_cols
+        self.left_on = left_on
+        self.right_on = right_on
+        self.how = how
+        self.left_id_only = left_id_only
+        self.out_columns = out_columns or (
+            ["__left_id__", "__right_id__"] + left_cols + right_cols
+        )
+        self.np_dtypes = np_dtypes or {}
+        # jk -> {row_key -> values}
+        self.state: list[dict[int, dict[int, tuple]]] = [defaultdict(dict), defaultdict(dict)]
+
+    def _pad(self, side: int) -> tuple:
+        """None-padding for the other side's columns."""
+        n = len(self.right_cols) if side == 0 else len(self.left_cols)
+        return tuple([None] * n)
+
+    def _out_key(self, lk: int | None, rk: int | None) -> int:
+        lk_ = np.asarray([0 if lk is None else lk], dtype=np.uint64)
+        rk_ = np.asarray([0 if rk is None else rk], dtype=np.uint64)
+        if self.left_id_only and lk is not None:
+            return int(lk)
+        if lk is None:
+            return int(splitmix64(rk_ ^ np.uint64(0xB0A0))[0])
+        if rk is None:
+            return int(splitmix64(lk_ ^ np.uint64(0xA0B0))[0])
+        return int(combine_keys(lk_, rk_)[0])
+
+    def _emit_matched(self, out, lk, lrow, rk, rrow, diff):
+        row = (lk, rk) + lrow + rrow
+        out.append((self._out_key(lk, rk), diff, row))
+
+    def _emit_left_pad(self, out, lk, lrow, diff):
+        row = (lk, None) + lrow + self._pad(0)
+        out.append((self._out_key(lk, None), diff, row))
+
+    def _emit_right_pad(self, out, rk, rrow, diff):
+        row = (None, rk) + self._pad(1) + rrow
+        out.append((self._out_key(None, rk), diff, row))
+
+    def process(self, inputs, time):
+        out: list[tuple[int, int, tuple]] = []
+        for side in (0, 1):
+            batch = inputs[side]
+            if batch is None:
+                continue
+            my_state = self.state[side]
+            other_state = self.state[1 - side]
+            on_col = batch.data[self.left_on if side == 0 else self.right_on]
+            val_cols = [
+                batch.data[c] for c in (self.left_cols if side == 0 else self.right_cols)
+            ]
+            pad_mine = self.how in ("left", "outer") if side == 0 else self.how in ("right", "outer")
+            pad_other = self.how in ("right", "outer") if side == 0 else self.how in ("left", "outer")
+            for i in range(len(batch)):
+                jk = int(np.uint64(on_col[i])) if on_col[i] is not None else None
+                rk = int(batch.keys[i])
+                row = tuple(c[i] for c in val_cols)
+                diff = int(batch.diffs[i])
+                if jk is None:
+                    # null join keys never match; padded if outer on my side
+                    if pad_mine:
+                        if side == 0:
+                            self._emit_left_pad(out, rk, row, diff)
+                        else:
+                            self._emit_right_pad(out, rk, row, diff)
+                    continue
+                mine = my_state[jk]
+                others = other_state[jk] if jk in other_state else {}
+                n_other = len(others)
+                n_mine_before = len(mine)
+                if diff > 0:
+                    mine[rk] = row
+                else:
+                    mine.pop(rk, None)
+                    if not mine:
+                        del my_state[jk]
+                # matched outputs
+                for ok, orow in others.items():
+                    if side == 0:
+                        self._emit_matched(out, rk, row, ok, orow, diff)
+                    else:
+                        self._emit_matched(out, ok, orow, rk, row, diff)
+                # my padded row when no match on the other side
+                if pad_mine and n_other == 0:
+                    if side == 0:
+                        self._emit_left_pad(out, rk, row, diff)
+                    else:
+                        self._emit_right_pad(out, rk, row, diff)
+                # other side's padded rows flip when my count transitions 0<->+
+                if pad_other:
+                    n_mine_after = n_mine_before + (1 if diff > 0 else -1)
+                    if n_mine_before == 0 and n_mine_after == 1:
+                        for ok, orow in others.items():
+                            if side == 0:
+                                self._emit_right_pad(out, ok, orow, -1)
+                            else:
+                                self._emit_left_pad(out, ok, orow, -1)
+                    elif n_mine_before == 1 and n_mine_after == 0:
+                        for ok, orow in others.items():
+                            if side == 0:
+                                self._emit_right_pad(out, ok, orow, +1)
+                            else:
+                                self._emit_left_pad(out, ok, orow, +1)
+        if not out:
+            return []
+        keys = [o[0] for o in out]
+        diffs = [o[1] for o in out]
+        rows = [o[2] for o in out]
+        batch = DeltaBatch.from_rows(
+            keys, rows, self.out_columns, time, diffs=diffs, np_dtypes=self.np_dtypes
+        )
+        return [consolidate(batch)]
+
+
+# ---------------------------------------------------------------------------- outputs
+
+
+class SubscribeNode(Node):
+    """``pw.io.subscribe`` (reference: ``io/_subscribe.py`` → ``subscribe_table``,
+    ``src/engine/graph.rs:543``)."""
+
+    name = "subscribe"
+
+    def __init__(
+        self,
+        columns: list[str],
+        on_change: Callable | None = None,
+        on_time_end: Callable | None = None,
+        on_end: Callable | None = None,
+    ):
+        super().__init__(n_inputs=1)
+        self.columns = columns
+        self.on_change = on_change
+        self.on_time_end = on_time_end
+        self._on_end = on_end
+        self._saw_data_at: int | None = None
+
+    def process(self, inputs, time):
+        batch = inputs[0]
+        if batch is None:
+            return []
+        self._saw_data_at = time
+        if self.on_change is not None:
+            for key, diff, row in batch.rows():
+                row_dict = dict(zip(self.columns, row))
+                self.on_change(key=key, row=row_dict, time=time, is_addition=diff > 0)
+        return []
+
+    def on_frontier(self, time):
+        if self.on_time_end is not None and self._saw_data_at == time and time != END_OF_STREAM:
+            self.on_time_end(time)
+        return []
+
+    def on_end(self):
+        if self._on_end is not None:
+            self._on_end()
+
+
+class CaptureNode(Node):
+    """Accumulates the final consolidated state (debug/compute_and_print) and the
+    full stream of deltas (stream assertions)."""
+
+    name = "capture"
+
+    def __init__(self, columns: list[str]):
+        super().__init__(n_inputs=1)
+        self.columns = columns
+        self.current: dict[int, tuple] = {}
+        self.deltas: list[tuple[int, int, int, tuple]] = []  # (time, key, diff, row)
+
+    def process(self, inputs, time):
+        batch = inputs[0]
+        if batch is None:
+            return []
+        for key, diff, row in batch.rows():
+            k = int(key)
+            self.deltas.append((time, k, diff, row))
+            if diff > 0:
+                self.current[k] = row
+            else:
+                self.current.pop(k, None)
+        return []
+
+
+class CallbackOutputNode(Node):
+    """Generic per-batch sink for io writers."""
+
+    name = "output"
+
+    def __init__(self, columns: list[str], on_batch: Callable, on_done: Callable | None = None):
+        super().__init__(n_inputs=1)
+        self.columns = columns
+        self.on_batch = on_batch
+        self.on_done = on_done
+
+    def process(self, inputs, time):
+        batch = inputs[0]
+        if batch is not None:
+            self.on_batch(batch, self.columns)
+        return []
+
+    def on_end(self):
+        if self.on_done is not None:
+            self.on_done()

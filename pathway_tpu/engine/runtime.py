@@ -1,0 +1,82 @@
+"""Run loop: ticks, autocommit, connector lifecycle.
+
+Role of the reference's ``run_with_new_dataflow_graph`` main loop
+(``src/engine/dataflow.rs:6111-6324``): build the engine graph from requested
+outputs, then either run one batch tick (static mode) or loop — poll connector
+threads, advance the logical time on autocommit ticks (``autocommit_duration_ms``),
+drain the dataflow — until every input is exhausted, then flush and close.
+"""
+
+from __future__ import annotations
+
+import time as _time
+from typing import Any, Protocol
+
+from pathway_tpu.engine.graph import Scheduler
+from pathway_tpu.internals.logical import LogicalNode, build_engine_graph
+
+
+class ConnectorDriver(Protocol):
+    """A live input source. ``start`` may spawn a thread pushing events into its
+    StreamInputNode; ``is_finished`` signals the source is exhausted (bounded
+    sources); unbounded sources stay alive until ``stop``."""
+
+    def start(self) -> None: ...
+
+    def is_finished(self) -> bool: ...
+
+    def stop(self) -> None: ...
+
+
+class Runtime:
+    def __init__(
+        self,
+        monitoring_level: Any = None,
+        autocommit_duration_ms: int | None = 20,
+    ):
+        self.connectors: list[ConnectorDriver] = []
+        self.autocommit_duration_ms = autocommit_duration_ms
+        self.monitoring_level = monitoring_level
+        self.scheduler: Scheduler | None = None
+        self._stop_requested = False
+
+    def register_connector(self, driver: ConnectorDriver) -> None:
+        self.connectors.append(driver)
+
+    def request_stop(self) -> None:
+        self._stop_requested = True
+
+    def run(self, outputs: list[LogicalNode]) -> Scheduler:
+        ctx = build_engine_graph(outputs, runtime=self)
+        scheduler = Scheduler(ctx.graph)
+        self.scheduler = scheduler
+
+        for driver in self.connectors:
+            driver.start()
+
+        if not self.connectors:
+            # static mode: single batch tick
+            scheduler.run_tick(0)
+            scheduler.close()
+            return scheduler
+
+        tick = 0
+        period = (self.autocommit_duration_ms or 20) / 1000.0
+        all_virtual = all(getattr(d, "virtual", False) for d in self.connectors)
+        try:
+            while not self._stop_requested:
+                t0 = _time.perf_counter()
+                scheduler.run_tick(tick)
+                tick += 1
+                if all(d.is_finished() for d in self.connectors):
+                    scheduler.run_tick(tick)  # drain any final events
+                    break
+                if not all_virtual:
+                    elapsed = _time.perf_counter() - t0
+                    if elapsed < period:
+                        _time.sleep(period - elapsed)
+        finally:
+            for driver in self.connectors:
+                driver.stop()
+        scheduler.close()
+        return scheduler

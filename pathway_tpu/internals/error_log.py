@@ -1,0 +1,30 @@
+"""Global error-log table (reference: ``parse_graph.py:183-238`` — schema:
+operator_id, message, trace; rows appear when ``terminate_on_error=False`` routes
+row-level failures to ``Value::Error`` + a log stream)."""
+
+from __future__ import annotations
+
+import threading
+
+from pathway_tpu.internals import schema as schema_mod
+
+_lock = threading.Lock()
+_entries: list[tuple[int, str, str]] = []
+
+
+def log_error(operator_id: int, message: str, trace: str = "") -> None:
+    with _lock:
+        _entries.append((operator_id, message, trace))
+
+
+ERROR_LOG_SCHEMA = schema_mod.schema_from_types(
+    operator_id=int, message=str, trace=str
+)
+
+
+def global_error_log():
+    from pathway_tpu.debug import table_from_rows
+
+    with _lock:
+        rows = list(_entries)
+    return table_from_rows(ERROR_LOG_SCHEMA, rows)

@@ -1,0 +1,52 @@
+"""Error / Pending sentinel values.
+
+Mirrors the reference's ``Value::Error`` poisoning semantics and ``Value::Pending``
+(``src/engine/value.rs:207-229``): a failed row-level computation yields ERROR which
+propagates through downstream expressions instead of aborting the run (when
+``terminate_on_error=False``); PENDING marks fully-async UDF results not yet arrived.
+"""
+
+from __future__ import annotations
+
+
+class _Error:
+    _instance = None
+
+    def __new__(cls):
+        if cls._instance is None:
+            cls._instance = super().__new__(cls)
+        return cls._instance
+
+    def __repr__(self) -> str:
+        return "Error"
+
+    def __bool__(self) -> bool:
+        raise ValueError("Error value used in a boolean context")
+
+
+class _Pending:
+    _instance = None
+
+    def __new__(cls):
+        if cls._instance is None:
+            cls._instance = super().__new__(cls)
+        return cls._instance
+
+    def __repr__(self) -> str:
+        return "Pending"
+
+
+ERROR = _Error()
+PENDING = _Pending()
+
+
+def is_error(v: object) -> bool:
+    return v is ERROR
+
+
+class EngineError(Exception):
+    pass
+
+
+class EngineErrorWithTrace(EngineError):
+    pass

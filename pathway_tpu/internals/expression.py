@@ -1,0 +1,927 @@
+"""Lazy column-expression AST.
+
+Mirrors the reference's ``python/pathway/internals/expression.py`` (ColumnExpression +
+~25 node types built by operator overloading: ref/const/binop/unop/reducer/apply/
+async-apply/cast/convert/coalesce/require/if_else/pointer/make_tuple/get/method-call/
+unwrap/fill_error) with the same user surface. Unlike the reference — which compiles
+these per-row into a Rust expression VM (``src/engine/expression.rs``) — this AST is
+compiled into **vectorized columnar kernels** over delta blocks
+(``pathway_tpu/engine/expression_vm.py``): numpy ufuncs on the host path and jitted
+JAX for large numeric blocks, so the MXU/VPU see whole batches instead of rows.
+"""
+
+from __future__ import annotations
+
+import datetime
+from typing import TYPE_CHECKING, Any, Callable, Iterable, Mapping
+
+from pathway_tpu.internals import dtype as dt
+
+if TYPE_CHECKING:
+    from pathway_tpu.internals.table import Table
+
+
+class ColumnExpression:
+    """Base lazy expression. Build with operator overloading: ``pw.this.a + 1``."""
+
+    _dtype_cache: dt.DType | None = None
+
+    # --- arithmetic ---
+    def __add__(self, other):
+        return BinOpExpression("+", self, wrap(other))
+
+    def __radd__(self, other):
+        return BinOpExpression("+", wrap(other), self)
+
+    def __sub__(self, other):
+        return BinOpExpression("-", self, wrap(other))
+
+    def __rsub__(self, other):
+        return BinOpExpression("-", wrap(other), self)
+
+    def __mul__(self, other):
+        return BinOpExpression("*", self, wrap(other))
+
+    def __rmul__(self, other):
+        return BinOpExpression("*", wrap(other), self)
+
+    def __truediv__(self, other):
+        return BinOpExpression("/", self, wrap(other))
+
+    def __rtruediv__(self, other):
+        return BinOpExpression("/", wrap(other), self)
+
+    def __floordiv__(self, other):
+        return BinOpExpression("//", self, wrap(other))
+
+    def __rfloordiv__(self, other):
+        return BinOpExpression("//", wrap(other), self)
+
+    def __mod__(self, other):
+        return BinOpExpression("%", self, wrap(other))
+
+    def __rmod__(self, other):
+        return BinOpExpression("%", wrap(other), self)
+
+    def __pow__(self, other):
+        return BinOpExpression("**", self, wrap(other))
+
+    def __rpow__(self, other):
+        return BinOpExpression("**", wrap(other), self)
+
+    def __matmul__(self, other):
+        return BinOpExpression("@", self, wrap(other))
+
+    def __rmatmul__(self, other):
+        return BinOpExpression("@", wrap(other), self)
+
+    def __neg__(self):
+        return UnOpExpression("-", self)
+
+    def __abs__(self):
+        return ApplyExpression(abs, float, args=(self,))
+
+    # --- comparison ---
+    def __eq__(self, other):  # type: ignore[override]
+        return BinOpExpression("==", self, wrap(other))
+
+    def __ne__(self, other):  # type: ignore[override]
+        return BinOpExpression("!=", self, wrap(other))
+
+    def __lt__(self, other):
+        return BinOpExpression("<", self, wrap(other))
+
+    def __le__(self, other):
+        return BinOpExpression("<=", self, wrap(other))
+
+    def __gt__(self, other):
+        return BinOpExpression(">", self, wrap(other))
+
+    def __ge__(self, other):
+        return BinOpExpression(">=", self, wrap(other))
+
+    # --- boolean / bitwise ---
+    def __and__(self, other):
+        return BinOpExpression("&", self, wrap(other))
+
+    def __rand__(self, other):
+        return BinOpExpression("&", wrap(other), self)
+
+    def __or__(self, other):
+        return BinOpExpression("|", self, wrap(other))
+
+    def __ror__(self, other):
+        return BinOpExpression("|", wrap(other), self)
+
+    def __xor__(self, other):
+        return BinOpExpression("^", self, wrap(other))
+
+    def __rxor__(self, other):
+        return BinOpExpression("^", wrap(other), self)
+
+    def __invert__(self):
+        return UnOpExpression("~", self)
+
+    def __bool__(self):
+        raise RuntimeError(
+            "ColumnExpression is lazy and cannot be used as a bool; "
+            "use &, |, ~ instead of and/or/not"
+        )
+
+    def __hash__(self) -> int:
+        return id(self)
+
+    # --- containers ---
+    def __getitem__(self, item) -> "GetExpression":
+        return GetExpression(self, wrap(item), check_if_exists=False)
+
+    def get(self, index, default=None) -> "GetExpression":
+        return GetExpression(self, wrap(index), default=wrap(default), check_if_exists=True)
+
+    # --- misc API (mirrors reference ColumnExpression methods) ---
+    def is_none(self) -> "IsNoneExpression":
+        return IsNoneExpression(self)
+
+    def is_not_none(self) -> "IsNotNoneExpression":
+        return IsNotNoneExpression(self)
+
+    def as_int(self):
+        return ConvertExpression(dt.INT, self)
+
+    def as_float(self):
+        return ConvertExpression(dt.FLOAT, self)
+
+    def as_str(self):
+        return ConvertExpression(dt.STR, self)
+
+    def as_bool(self):
+        return ConvertExpression(dt.BOOL, self)
+
+    def to_string(self):
+        return MethodCallExpression("gen", "to_string", (self,))
+
+    def fill_error(self, replacement) -> "FillErrorExpression":
+        return FillErrorExpression(self, wrap(replacement))
+
+    @property
+    def dt(self) -> "DateTimeNamespace":
+        return DateTimeNamespace(self)
+
+    @property
+    def str(self) -> "StringNamespace":
+        return StringNamespace(self)
+
+    @property
+    def num(self) -> "NumericalNamespace":
+        return NumericalNamespace(self)
+
+    # --- internals ---
+    def _args(self) -> tuple["ColumnExpression", ...]:
+        return ()
+
+    def _with_args(self, args: tuple["ColumnExpression", ...]) -> "ColumnExpression":
+        return self
+
+    def _dtype(self, env: "TypeEnv") -> dt.DType:
+        raise NotImplementedError
+
+
+ColumnExpressionOrValue = Any
+
+
+def wrap(value: ColumnExpressionOrValue) -> ColumnExpression:
+    if isinstance(value, ColumnExpression):
+        return value
+    return ConstExpression(value)
+
+
+def smart_name(expr: ColumnExpression) -> str | None:
+    if isinstance(expr, ColumnReference):
+        return expr.name
+    return None
+
+
+class TypeEnv:
+    """Maps tables to schemas during static type inference (role of the reference's
+    ``internals/type_interpreter.py``)."""
+
+    def __init__(self) -> None:
+        pass
+
+    def dtype_of(self, ref: "ColumnReference") -> dt.DType:
+        table = ref.table
+        if table is None:
+            raise RuntimeError(f"unbound column reference {ref.name!r}")
+        if ref.name == "id":
+            return dt.POINTER
+        return table.schema.dtypes()[ref.name]
+
+
+TYPE_ENV = TypeEnv()
+
+
+class ColumnReference(ColumnExpression):
+    """``table.colname`` / ``pw.this.colname`` (bound during desugaring)."""
+
+    def __init__(self, table: "Table | None", name: str):
+        self.table = table
+        self.name = name
+
+    def __repr__(self) -> str:
+        t = "this" if self.table is None else f"<table {id(self.table):x}>"
+        return f"{t}.{self.name}"
+
+    def _dtype(self, env: TypeEnv) -> dt.DType:
+        return env.dtype_of(self)
+
+    @property
+    def _column_name(self) -> str:
+        return self.name
+
+
+class ConstExpression(ColumnExpression):
+    def __init__(self, value: Any):
+        self.value = value
+
+    def __repr__(self) -> str:
+        return repr(self.value)
+
+    def _dtype(self, env: TypeEnv) -> dt.DType:
+        return dt.dtype_of_value(self.value)
+
+
+_ARITH = {"+", "-", "*", "/", "//", "%", "**", "@"}
+_CMP = {"==", "!=", "<", "<=", ">", ">="}
+_BITS = {"&", "|", "^"}
+
+
+def binop_result_type(op: str, lt: dt.DType, rt: dt.DType) -> dt.DType:
+    l, r = dt.unoptionalize(lt), dt.unoptionalize(rt)
+    opt = lt.is_optional() or rt.is_optional()
+
+    def out(d: dt.DType) -> dt.DType:
+        return dt.Optional(d) if opt and op not in _CMP else d
+
+    if op in _CMP:
+        return dt.BOOL
+    if op in _BITS:
+        if l == dt.BOOL and r == dt.BOOL:
+            return out(dt.BOOL)
+        if l == dt.INT and r == dt.INT:
+            return out(dt.INT)
+        return out(dt.ANY)
+    num = {dt.INT, dt.FLOAT}
+    if l in num and r in num:
+        if op == "/":
+            return out(dt.FLOAT)
+        if op in ("//", "%") and l == dt.INT and r == dt.INT:
+            return out(dt.INT)
+        if l == dt.FLOAT or r == dt.FLOAT or op == "/":
+            return out(dt.FLOAT)
+        if op == "**":
+            return out(dt.INT)
+        return out(dt.INT)
+    if l == dt.STR and r == dt.STR and op == "+":
+        return out(dt.STR)
+    if l == dt.STR and r == dt.INT and op == "*":
+        return out(dt.STR)
+    dtm = {dt.DATE_TIME_NAIVE, dt.DATE_TIME_UTC}
+    if l in dtm and r in dtm and op == "-":
+        return out(dt.DURATION)
+    if l in dtm and r == dt.DURATION and op in ("+", "-"):
+        return out(l)
+    if l == dt.DURATION and r in dtm and op == "+":
+        return out(r)
+    if l == dt.DURATION and r == dt.DURATION:
+        if op in ("+", "-"):
+            return out(dt.DURATION)
+        if op == "/":
+            return out(dt.FLOAT)
+        if op in ("//",):
+            return out(dt.INT)
+        if op == "%":
+            return out(dt.DURATION)
+    if l == dt.DURATION and r in num and op in ("*", "/", "//"):
+        return out(dt.DURATION)
+    if l in num and r == dt.DURATION and op == "*":
+        return out(dt.DURATION)
+    if isinstance(l, dt.Array) or isinstance(r, dt.Array):
+        return out(dt.ANY_ARRAY)
+    if isinstance(l, dt.Tuple) and isinstance(r, dt.Tuple) and op == "+":
+        return out(dt.Tuple(*(l.args + r.args)))
+    return out(dt.ANY)
+
+
+class BinOpExpression(ColumnExpression):
+    def __init__(self, op: str, left: ColumnExpression, right: ColumnExpression):
+        self.op = op
+        self.left = left
+        self.right = right
+
+    def __repr__(self) -> str:
+        return f"({self.left!r} {self.op} {self.right!r})"
+
+    def _args(self):
+        return (self.left, self.right)
+
+    def _with_args(self, args):
+        return BinOpExpression(self.op, *args)
+
+    def _dtype(self, env: TypeEnv) -> dt.DType:
+        return binop_result_type(self.op, self.left._dtype(env), self.right._dtype(env))
+
+
+class UnOpExpression(ColumnExpression):
+    def __init__(self, op: str, operand: ColumnExpression):
+        self.op = op
+        self.operand = operand
+
+    def _args(self):
+        return (self.operand,)
+
+    def _with_args(self, args):
+        return UnOpExpression(self.op, *args)
+
+    def _dtype(self, env: TypeEnv) -> dt.DType:
+        inner = self.operand._dtype(env)
+        if self.op == "~":
+            return inner
+        return inner  # unary minus preserves numeric dtype
+
+
+class IsNoneExpression(ColumnExpression):
+    def __init__(self, operand: ColumnExpression):
+        self.operand = operand
+
+    def _args(self):
+        return (self.operand,)
+
+    def _with_args(self, args):
+        return IsNoneExpression(*args)
+
+    def _dtype(self, env: TypeEnv) -> dt.DType:
+        return dt.BOOL
+
+
+class IsNotNoneExpression(IsNoneExpression):
+    def _with_args(self, args):
+        return IsNotNoneExpression(*args)
+
+
+class IfElseExpression(ColumnExpression):
+    """``pw.if_else(cond, then, else_)``."""
+
+    def __init__(self, if_: ColumnExpression, then: ColumnExpression, else_: ColumnExpression):
+        self.if_ = if_
+        self.then = then
+        self.else_ = else_
+
+    def _args(self):
+        return (self.if_, self.then, self.else_)
+
+    def _with_args(self, args):
+        return IfElseExpression(*args)
+
+    def _dtype(self, env: TypeEnv) -> dt.DType:
+        return dt.types_lca(self.then._dtype(env), self.else_._dtype(env))
+
+
+class CoalesceExpression(ColumnExpression):
+    def __init__(self, *args: ColumnExpression):
+        self.args = tuple(wrap(a) for a in args)
+
+    def _args(self):
+        return self.args
+
+    def _with_args(self, args):
+        return CoalesceExpression(*args)
+
+    def _dtype(self, env: TypeEnv) -> dt.DType:
+        out: dt.DType | None = None
+        for a in self.args:
+            d = a._dtype(env)
+            out = d if out is None else dt.types_lca(out, d)
+        assert out is not None
+        # if last arg is non-optional, the whole coalesce is non-optional
+        if not self.args[-1]._dtype(env).is_optional() and isinstance(out, dt.Optional):
+            return out.wrapped
+        return out
+
+
+class RequireExpression(ColumnExpression):
+    """``pw.require(val, *conds)`` — None if any cond is None."""
+
+    def __init__(self, val: ColumnExpression, *args: ColumnExpression):
+        self.val = wrap(val)
+        self.conds = tuple(wrap(a) for a in args)
+
+    def _args(self):
+        return (self.val, *self.conds)
+
+    def _with_args(self, args):
+        return RequireExpression(*args)
+
+    def _dtype(self, env: TypeEnv) -> dt.DType:
+        return dt.Optional(self.val._dtype(env))
+
+
+class ApplyExpression(ColumnExpression):
+    """``pw.apply(fn, *args)`` — per-row python call (sync)."""
+
+    def __init__(
+        self,
+        fn: Callable,
+        return_type: Any,
+        args: tuple = (),
+        kwargs: Mapping[str, Any] | None = None,
+        propagate_none: bool = False,
+        deterministic: bool = True,
+    ):
+        self.fn = fn
+        self.return_type = dt.wrap(return_type) if return_type is not None else dt.ANY
+        self.args_ = tuple(wrap(a) for a in args)
+        self.kwargs_ = {k: wrap(v) for k, v in (kwargs or {}).items()}
+        self.propagate_none = propagate_none
+        self.deterministic = deterministic
+
+    def _args(self):
+        return self.args_ + tuple(self.kwargs_.values())
+
+    def _with_args(self, args):
+        n = len(self.args_)
+        new = type(self)(
+            self.fn,
+            self.return_type,
+            args=tuple(args[:n]),
+            kwargs=dict(zip(self.kwargs_.keys(), args[n:])),
+            propagate_none=self.propagate_none,
+            deterministic=self.deterministic,
+        )
+        return new
+
+    def _dtype(self, env: TypeEnv) -> dt.DType:
+        return self.return_type
+
+
+class AsyncApplyExpression(ApplyExpression):
+    """``pw.apply_async`` — batched through the microbatcher instead of the
+    reference's one-boxed-future-per-row (``src/engine/dataflow.rs:1924-1962``)."""
+
+
+class FullyAsyncApplyExpression(ApplyExpression):
+    """Returns Pending immediately, result arrives as a later update."""
+
+    def _dtype(self, env: TypeEnv) -> dt.DType:
+        return dt.Future(self.return_type)
+
+
+class CastExpression(ColumnExpression):
+    def __init__(self, target: Any, expr: ColumnExpression):
+        self.target = dt.wrap(target)
+        self.expr = wrap(expr)
+
+    def _args(self):
+        return (self.expr,)
+
+    def _with_args(self, args):
+        return CastExpression(self.target, *args)
+
+    def _dtype(self, env: TypeEnv) -> dt.DType:
+        if self.expr._dtype(env).is_optional():
+            return dt.Optional(self.target)
+        return self.target
+
+
+class ConvertExpression(ColumnExpression):
+    """Json/any → concrete type conversion (``as_int`` etc.)."""
+
+    def __init__(self, target: dt.DType, expr: ColumnExpression, unwrap: bool = False):
+        self.target = target
+        self.expr = wrap(expr)
+        self.unwrap_ = unwrap
+
+    def _args(self):
+        return (self.expr,)
+
+    def _with_args(self, args):
+        return ConvertExpression(self.target, *args, unwrap=self.unwrap_)
+
+    def _dtype(self, env: TypeEnv) -> dt.DType:
+        return self.target if self.unwrap_ else dt.Optional(self.target)
+
+
+class DeclareTypeExpression(ColumnExpression):
+    def __init__(self, target: Any, expr: ColumnExpression):
+        self.target = dt.wrap(target)
+        self.expr = wrap(expr)
+
+    def _args(self):
+        return (self.expr,)
+
+    def _with_args(self, args):
+        return DeclareTypeExpression(self.target, *args)
+
+    def _dtype(self, env: TypeEnv) -> dt.DType:
+        return self.target
+
+
+class UnwrapExpression(ColumnExpression):
+    def __init__(self, expr: ColumnExpression):
+        self.expr = wrap(expr)
+
+    def _args(self):
+        return (self.expr,)
+
+    def _with_args(self, args):
+        return UnwrapExpression(*args)
+
+    def _dtype(self, env: TypeEnv) -> dt.DType:
+        return dt.unoptionalize(self.expr._dtype(env))
+
+
+class FillErrorExpression(ColumnExpression):
+    def __init__(self, expr: ColumnExpression, replacement: ColumnExpression):
+        self.expr = wrap(expr)
+        self.replacement = wrap(replacement)
+
+    def _args(self):
+        return (self.expr, self.replacement)
+
+    def _with_args(self, args):
+        return FillErrorExpression(*args)
+
+    def _dtype(self, env: TypeEnv) -> dt.DType:
+        return dt.types_lca(self.expr._dtype(env), self.replacement._dtype(env))
+
+
+class MakeTupleExpression(ColumnExpression):
+    def __init__(self, *args: ColumnExpression):
+        self.args = tuple(wrap(a) for a in args)
+
+    def _args(self):
+        return self.args
+
+    def _with_args(self, args):
+        return MakeTupleExpression(*args)
+
+    def _dtype(self, env: TypeEnv) -> dt.DType:
+        return dt.Tuple(*[a._dtype(env) for a in self.args])
+
+
+class GetExpression(ColumnExpression):
+    def __init__(
+        self,
+        obj: ColumnExpression,
+        index: ColumnExpression,
+        default: ColumnExpression | None = None,
+        check_if_exists: bool = False,
+    ):
+        self.obj = wrap(obj)
+        self.index = wrap(index)
+        self.default = default if default is None else wrap(default)
+        self.check_if_exists = check_if_exists
+
+    def _args(self):
+        extra = (self.default,) if self.default is not None else ()
+        return (self.obj, self.index, *extra)
+
+    def _with_args(self, args):
+        if len(args) == 3:
+            return GetExpression(args[0], args[1], args[2], self.check_if_exists)
+        return GetExpression(args[0], args[1], None, self.check_if_exists)
+
+    def _dtype(self, env: TypeEnv) -> dt.DType:
+        objt = dt.unoptionalize(self.obj._dtype(env))
+        if isinstance(objt, dt.Tuple) and isinstance(self.index, ConstExpression):
+            i = self.index.value
+            if isinstance(i, int) and objt.args and -len(objt.args) <= i < len(objt.args):
+                out = objt.args[i]
+            else:
+                out = dt.ANY
+        elif isinstance(objt, dt.List):
+            out = objt.wrapped_
+        elif objt == dt.JSON:
+            out = dt.JSON
+        elif isinstance(objt, dt.Array):
+            out = dt.Array(None if objt.n_dim is None else objt.n_dim - 1, objt.wrapped_) \
+                if (objt.n_dim or 2) > 1 else objt.wrapped_
+        else:
+            out = dt.ANY
+        if self.check_if_exists and self.default is not None:
+            out = dt.types_lca(out, self.default._dtype(env))
+        return out
+
+
+class MethodCallExpression(ColumnExpression):
+    """Namespace method call (``expr.dt.hour()``, ``expr.str.lower()``…)."""
+
+    def __init__(self, namespace: str, name: str, args: tuple, result_dtype: dt.DType | None = None):
+        self.namespace = namespace
+        self.name = name
+        self.args = tuple(wrap(a) for a in args)
+        self.result_dtype = result_dtype
+
+    def _args(self):
+        return self.args
+
+    def _with_args(self, args):
+        return MethodCallExpression(self.namespace, self.name, tuple(args), self.result_dtype)
+
+    def _dtype(self, env: TypeEnv) -> dt.DType:
+        if self.result_dtype is not None:
+            return self.result_dtype
+        from pathway_tpu.engine.namespaces import method_result_dtype
+
+        return method_result_dtype(self.namespace, self.name, [a._dtype(env) for a in self.args])
+
+
+class PointerExpression(ColumnExpression):
+    """``table.pointer_from(*cols)`` — key hash of the argument values."""
+
+    def __init__(self, table: "Table | None", *args: ColumnExpression, optional: bool = False, instance=None):
+        self.table = table
+        self.args = tuple(wrap(a) for a in args)
+        self.optional = optional
+        self.instance = instance
+
+    def _args(self):
+        return self.args
+
+    def _with_args(self, args):
+        return PointerExpression(self.table, *args, optional=self.optional, instance=self.instance)
+
+    def _dtype(self, env: TypeEnv) -> dt.DType:
+        return dt.Optional(dt.POINTER) if self.optional else dt.POINTER
+
+
+class ReducerExpression(ColumnExpression):
+    """A reducer applied inside ``groupby(...).reduce(...)``."""
+
+    def __init__(self, reducer: "Any", *args: ColumnExpression, **kwargs: Any):
+        self.reducer = reducer
+        self.args = tuple(wrap(a) for a in args)
+        self.kwargs = kwargs
+
+    def __repr__(self) -> str:
+        return f"{self.reducer.name}({', '.join(map(repr, self.args))})"
+
+    def _args(self):
+        return self.args
+
+    def _with_args(self, args):
+        return ReducerExpression(self.reducer, *args, **self.kwargs)
+
+    def _dtype(self, env: TypeEnv) -> dt.DType:
+        return self.reducer.result_dtype([a._dtype(env) for a in self.args])
+
+
+# ----------------------------------------------------------------------------
+# namespaces (subset of reference's expressions/date_time.py & string.py)
+# ----------------------------------------------------------------------------
+
+
+class _Namespace:
+    _ns: str = ""
+
+    def __init__(self, expr: ColumnExpression):
+        self._expr = expr
+
+    def _call(self, name: str, *args, result_dtype: dt.DType | None = None):
+        return MethodCallExpression(self._ns, name, (self._expr, *args), result_dtype)
+
+
+class DateTimeNamespace(_Namespace):
+    _ns = "dt"
+
+    def nanosecond(self):
+        return self._call("nanosecond", result_dtype=dt.INT)
+
+    def microsecond(self):
+        return self._call("microsecond", result_dtype=dt.INT)
+
+    def millisecond(self):
+        return self._call("millisecond", result_dtype=dt.INT)
+
+    def second(self):
+        return self._call("second", result_dtype=dt.INT)
+
+    def minute(self):
+        return self._call("minute", result_dtype=dt.INT)
+
+    def hour(self):
+        return self._call("hour", result_dtype=dt.INT)
+
+    def day(self):
+        return self._call("day", result_dtype=dt.INT)
+
+    def month(self):
+        return self._call("month", result_dtype=dt.INT)
+
+    def year(self):
+        return self._call("year", result_dtype=dt.INT)
+
+    def day_of_week(self):
+        return self._call("day_of_week", result_dtype=dt.INT)
+
+    def timestamp(self, unit: str = "ns"):
+        return self._call("timestamp", wrap(unit), result_dtype=dt.FLOAT if unit != "ns" else dt.INT)
+
+    def strftime(self, fmt):
+        return self._call("strftime", wrap(fmt), result_dtype=dt.STR)
+
+    def strptime(self, fmt, contains_timezone: bool = False):
+        return self._call(
+            "strptime",
+            wrap(fmt),
+            result_dtype=dt.DATE_TIME_UTC if contains_timezone else dt.DATE_TIME_NAIVE,
+        )
+
+    def to_utc(self, from_timezone: str):
+        return self._call("to_utc", wrap(from_timezone), result_dtype=dt.DATE_TIME_UTC)
+
+    def to_naive_in_timezone(self, timezone: str):
+        return self._call("to_naive_in_timezone", wrap(timezone), result_dtype=dt.DATE_TIME_NAIVE)
+
+    def round(self, duration):
+        return self._call("round", wrap(duration))
+
+    def floor(self, duration):
+        return self._call("floor", wrap(duration))
+
+    def nanoseconds(self):
+        return self._call("nanoseconds", result_dtype=dt.INT)
+
+    def microseconds(self):
+        return self._call("microseconds", result_dtype=dt.INT)
+
+    def milliseconds(self):
+        return self._call("milliseconds", result_dtype=dt.INT)
+
+    def seconds(self):
+        return self._call("seconds", result_dtype=dt.INT)
+
+    def minutes(self):
+        return self._call("minutes", result_dtype=dt.INT)
+
+    def hours(self):
+        return self._call("hours", result_dtype=dt.INT)
+
+    def days(self):
+        return self._call("days", result_dtype=dt.INT)
+
+    def weeks(self):
+        return self._call("weeks", result_dtype=dt.INT)
+
+    def from_timestamp(self, unit: str):
+        return self._call("from_timestamp", wrap(unit), result_dtype=dt.DATE_TIME_NAIVE)
+
+    def utc_from_timestamp(self, unit: str):
+        return self._call("utc_from_timestamp", wrap(unit), result_dtype=dt.DATE_TIME_UTC)
+
+
+class StringNamespace(_Namespace):
+    _ns = "str"
+
+    def lower(self):
+        return self._call("lower", result_dtype=dt.STR)
+
+    def upper(self):
+        return self._call("upper", result_dtype=dt.STR)
+
+    def strip(self, chars=None):
+        return self._call("strip", wrap(chars), result_dtype=dt.STR)
+
+    def lstrip(self, chars=None):
+        return self._call("lstrip", wrap(chars), result_dtype=dt.STR)
+
+    def rstrip(self, chars=None):
+        return self._call("rstrip", wrap(chars), result_dtype=dt.STR)
+
+    def len(self):
+        return self._call("len", result_dtype=dt.INT)
+
+    def reversed(self):
+        return self._call("reversed", result_dtype=dt.STR)
+
+    def startswith(self, prefix):
+        return self._call("startswith", wrap(prefix), result_dtype=dt.BOOL)
+
+    def endswith(self, suffix):
+        return self._call("endswith", wrap(suffix), result_dtype=dt.BOOL)
+
+    def count(self, sub):
+        return self._call("count", wrap(sub), result_dtype=dt.INT)
+
+    def find(self, sub):
+        return self._call("find", wrap(sub), result_dtype=dt.INT)
+
+    def rfind(self, sub):
+        return self._call("rfind", wrap(sub), result_dtype=dt.INT)
+
+    def replace(self, old, new):
+        return self._call("replace", wrap(old), wrap(new), result_dtype=dt.STR)
+
+    def split(self, sep=None, maxsplit: int = -1):
+        return self._call("split", wrap(sep), wrap(maxsplit), result_dtype=dt.List(dt.STR))
+
+    def slice(self, start, end):
+        return self._call("slice", wrap(start), wrap(end), result_dtype=dt.STR)
+
+    def title(self):
+        return self._call("title", result_dtype=dt.STR)
+
+    def swapcase(self):
+        return self._call("swapcase", result_dtype=dt.STR)
+
+    def parse_int(self, optional: bool = False):
+        d = dt.Optional(dt.INT) if optional else dt.INT
+        return self._call("parse_int", wrap(optional), result_dtype=d)
+
+    def parse_float(self, optional: bool = False):
+        d = dt.Optional(dt.FLOAT) if optional else dt.FLOAT
+        return self._call("parse_float", wrap(optional), result_dtype=d)
+
+    def parse_bool(self, optional: bool = False):
+        d = dt.Optional(dt.BOOL) if optional else dt.BOOL
+        return self._call("parse_bool", wrap(optional), result_dtype=d)
+
+
+class NumericalNamespace(_Namespace):
+    _ns = "num"
+
+    def abs(self):
+        return self._call("abs")
+
+    def round(self, decimals=0):
+        return self._call("round", wrap(decimals))
+
+    def fill_na(self, default_value):
+        return self._call("fill_na", wrap(default_value))
+
+
+# ----------------------------------------------------------------------------
+# public expression-builder functions (``pw.if_else`` etc.)
+# ----------------------------------------------------------------------------
+
+
+def if_else(if_, then, else_) -> IfElseExpression:
+    return IfElseExpression(wrap(if_), wrap(then), wrap(else_))
+
+
+def coalesce(*args) -> CoalesceExpression:
+    return CoalesceExpression(*args)
+
+
+def require(val, *args) -> RequireExpression:
+    return RequireExpression(val, *args)
+
+
+def cast(target, expr) -> CastExpression:
+    return CastExpression(target, wrap(expr))
+
+
+def declare_type(target, expr) -> DeclareTypeExpression:
+    return DeclareTypeExpression(target, wrap(expr))
+
+
+def unwrap(expr) -> UnwrapExpression:
+    return UnwrapExpression(wrap(expr))
+
+
+def fill_error(expr, replacement) -> FillErrorExpression:
+    return FillErrorExpression(wrap(expr), wrap(replacement))
+
+
+def make_tuple(*args) -> MakeTupleExpression:
+    return MakeTupleExpression(*args)
+
+
+def apply(fn: Callable, *args, **kwargs) -> ApplyExpression:
+    return_type = _infer_return_type(fn)
+    return ApplyExpression(fn, return_type, args=args, kwargs=kwargs)
+
+
+def apply_with_type(fn: Callable, ret_type: Any, *args, **kwargs) -> ApplyExpression:
+    return ApplyExpression(fn, ret_type, args=args, kwargs=kwargs)
+
+
+def apply_async(fn: Callable, *args, **kwargs) -> AsyncApplyExpression:
+    return_type = _infer_return_type(fn)
+    return AsyncApplyExpression(fn, return_type, args=args, kwargs=kwargs)
+
+
+def _infer_return_type(fn: Callable) -> Any:
+    try:
+        import typing
+
+        hints = typing.get_type_hints(fn)
+        return hints.get("return", Any)
+    except Exception:
+        return Any
+
+
+def assert_expression_bound(expr: ColumnExpression) -> None:
+    for arg in expr._args():
+        assert_expression_bound(arg)
+    if isinstance(expr, ColumnReference) and expr.table is None:
+        raise RuntimeError(f"unbound reference to column {expr.name!r}")

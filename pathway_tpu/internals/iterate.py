@@ -1,0 +1,20 @@
+"""``pw.iterate`` — fixed-point iteration (reference: ``internals/common.py:39`` /
+``IterateOperator`` ``operator.py:316`` / engine ``src/engine/dataflow.rs:4275``).
+
+Full implementation lands with the graphs stdlib milestone; the engine node loops the
+body subgraph inside a tick until collections stop changing.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+
+def iterate(body: Callable, iteration_limit: int | None = None, **tables: Any):
+    from pathway_tpu.internals.iterate_impl import iterate_impl
+
+    return iterate_impl(body, iteration_limit, **tables)
+
+
+def iterate_universe(body: Callable, **tables: Any):
+    return iterate(body, **tables)

@@ -1,0 +1,73 @@
+"""Logical operator nodes + lowering to the engine graph.
+
+Role of the reference's ``internals/operator.py`` + ``internals/graph_runner/``:
+Table methods create ``LogicalNode``s (declarative, lazy — nothing computes until
+``pw.run``/``compute_and_print``); lowering walks from requested outputs, instantiates
+fresh engine nodes per run (tree-shaking unused operators like
+``graph_runner/__init__.py:127,246``), and wires connector drivers into the runtime.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+from pathway_tpu.engine.graph import EngineGraph, Node
+from pathway_tpu.internals.parse_graph import G
+
+
+class LogicalNode:
+    """A lazy operator: ``factory()`` builds a fresh engine node each run."""
+
+    def __init__(
+        self,
+        factory: Callable[[], Node],
+        inputs: list["LogicalNode"],
+        name: str = "op",
+        runtime_hook: Callable[[Node, Any], None] | None = None,
+    ):
+        self.factory = factory
+        self.inputs = inputs
+        self.name = name
+        self.runtime_hook = runtime_hook
+        self.node_id: int = -1
+        G.register(self)
+
+    def __repr__(self) -> str:
+        return f"LogicalNode({self.name}#{self.node_id})"
+
+    def _register_as_output(self) -> "LogicalNode":
+        G.outputs.append(self)
+        return self
+
+
+class BuildContext:
+    def __init__(self, runtime: Any = None):
+        self.graph = EngineGraph()
+        self.built: dict[int, Node] = {}
+        self.runtime = runtime
+        self.hooks: list[tuple[LogicalNode, Node]] = []
+
+    def resolve(self, lnode: LogicalNode) -> Node:
+        node = self.built.get(id(lnode))
+        if node is not None:
+            return node
+        engine_inputs = [self.resolve(i) for i in lnode.inputs]
+        node = lnode.factory()
+        node.name = lnode.name
+        self.graph.add_node(node, engine_inputs)
+        self.built[id(lnode)] = node
+        if lnode.runtime_hook is not None:
+            self.hooks.append((lnode, node))
+        return node
+
+    def finish(self) -> None:
+        for lnode, node in self.hooks:
+            lnode.runtime_hook(node, self.runtime)
+
+
+def build_engine_graph(outputs: list[LogicalNode], runtime: Any = None) -> BuildContext:
+    ctx = BuildContext(runtime)
+    for out in outputs:
+        ctx.resolve(out)
+    ctx.finish()
+    return ctx

@@ -1,0 +1,44 @@
+"""Global declarative graph registry.
+
+Mirrors the reference's ``internals/parse_graph.py`` (global mutable ``ParseGraph G``
+with node-id sequence, scope stack for ``iterate``, error-log stack and statistics).
+Nodes here are the logical operators created by Table methods; ``pw.run()`` walks
+from requested outputs and instantiates the engine dataflow.
+"""
+
+from __future__ import annotations
+
+import itertools
+from collections import Counter
+from typing import TYPE_CHECKING, Any
+
+if TYPE_CHECKING:
+    from pathway_tpu.internals.logical import LogicalNode
+
+
+class ParseGraph:
+    def __init__(self) -> None:
+        self.node_seq = itertools.count()
+        self.nodes: list["LogicalNode"] = []
+        self.outputs: list[Any] = []  # output/subscribe logical nodes
+        self.error_log_tables: list[Any] = []
+        self.cache: dict[Any, Any] = {}
+
+    def register(self, node: "LogicalNode") -> "LogicalNode":
+        node.node_id = next(self.node_seq)
+        self.nodes.append(node)
+        return node
+
+    def register_output(self, node: "LogicalNode") -> "LogicalNode":
+        self.register(node)
+        self.outputs.append(node)
+        return node
+
+    def clear(self) -> None:
+        self.__init__()
+
+    def statistics(self) -> dict[str, int]:
+        return dict(Counter(type(n).__name__ for n in self.nodes))
+
+
+G = ParseGraph()

@@ -1,0 +1,63 @@
+"""``pw.run`` / ``pw.run_all``.
+
+Mirrors the reference's ``internals/run.py`` → GraphRunner flow
+(``internals/graph_runner/__init__.py:111-246``): collect requested outputs from the
+global graph, tree-shake, instantiate the engine dataflow, and drive it to completion
+(streaming sources run until exhausted or ``persistence``/monitoring shutdown).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from pathway_tpu.engine.runtime import Runtime
+from pathway_tpu.internals.parse_graph import G
+
+
+class MonitoringLevel:
+    AUTO = "auto"
+    NONE = "none"
+    IN_OUT = "in_out"
+    ALL = "all"
+
+
+_last_runtime: Runtime | None = None
+
+
+def run(
+    *,
+    monitoring_level: Any = MonitoringLevel.AUTO,
+    with_http_server: bool = False,
+    autocommit_duration_ms: int | None = 20,
+    persistence_config: Any = None,
+    runtime_typechecking: bool | None = None,
+    terminate_on_error: bool = True,
+    **kwargs: Any,
+) -> None:
+    """Execute every output (sink/subscribe/debug) registered so far."""
+    global _last_runtime
+    if not G.outputs:
+        import warnings
+
+        warnings.warn("pw.run(): no outputs registered; nothing to do")
+        return
+    runtime = Runtime(
+        monitoring_level=monitoring_level,
+        autocommit_duration_ms=autocommit_duration_ms,
+    )
+    if persistence_config is not None:
+        from pathway_tpu.persistence import attach_persistence
+
+        attach_persistence(runtime, persistence_config)
+    _last_runtime = runtime
+    scheduler = runtime.run(list(G.outputs))
+    if with_http_server:
+        pass  # metrics server lifecycle is bound to the run; see monitoring module
+    return None
+
+
+run_all = run
+
+
+def current_runtime() -> Runtime | None:
+    return _last_runtime

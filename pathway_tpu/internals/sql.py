@@ -1,0 +1,110 @@
+"""``pw.sql`` — SQL → Table API translation (reference: ``internals/sql.py`` via
+sqlglot). sqlglot is not available in this environment; a minimal translator covers
+the common SELECT/WHERE/GROUP BY shapes used in the reference's tests."""
+
+from __future__ import annotations
+
+import re
+from typing import Any
+
+from pathway_tpu.internals import expression as expr_mod
+from pathway_tpu.internals import reducers
+from pathway_tpu.internals.table import Table
+
+_AGGS = {
+    "count": lambda args: reducers.count(),
+    "sum": lambda args: reducers.sum(args[0]),
+    "min": lambda args: reducers.min(args[0]),
+    "max": lambda args: reducers.max(args[0]),
+    "avg": lambda args: reducers.avg(args[0]),
+}
+
+
+def sql(query: str, **tables: Table) -> Table:
+    try:
+        import sqlglot  # noqa: F401
+
+        raise NotImplementedError("sqlglot backend not wired yet")
+    except ImportError:
+        pass
+    return _mini_sql(query, tables)
+
+
+def _mini_sql(query: str, tables: dict[str, Table]) -> Table:
+    q = re.sub(r"\s+", " ", query.strip().rstrip(";"))
+    m = re.match(
+        r"(?is)select (?P<sel>.*?) from (?P<tab>\w+)"
+        r"(?: where (?P<where>.*?))?(?: group by (?P<gb>.*?))?$",
+        q,
+    )
+    if not m:
+        raise ValueError(f"unsupported SQL: {query!r}")
+    t = tables[m.group("tab")]
+    if m.group("where"):
+        t = t.filter(_parse_expr(m.group("where"), t))
+    sel_items = _split_commas(m.group("sel"))
+    if m.group("gb"):
+        gb_cols = [c.strip() for c in _split_commas(m.group("gb"))]
+        grouped = t.groupby(*[t[c] for c in gb_cols])
+        exprs = {}
+        for item in sel_items:
+            name, e = _parse_select_item(item, t)
+            exprs[name] = e
+        return grouped.reduce(**exprs)
+    if len(sel_items) == 1 and sel_items[0].strip() == "*":
+        return t
+    exprs = {}
+    for item in sel_items:
+        name, e = _parse_select_item(item, t)
+        exprs[name] = e
+    return t.select(**exprs)
+
+
+def _split_commas(s: str) -> list[str]:
+    out, depth, cur = [], 0, []
+    for ch in s:
+        if ch == "(":
+            depth += 1
+        elif ch == ")":
+            depth -= 1
+        if ch == "," and depth == 0:
+            out.append("".join(cur))
+            cur = []
+        else:
+            cur.append(ch)
+    if cur:
+        out.append("".join(cur))
+    return out
+
+
+def _parse_select_item(item: str, t: Table):
+    item = item.strip()
+    m = re.match(r"(?is)^(?P<expr>.+?)\s+as\s+(?P<alias>\w+)$", item)
+    alias = None
+    if m:
+        alias = m.group("alias")
+        item = m.group("expr").strip()
+    e = _parse_expr(item, t)
+    if alias is None:
+        alias = item if re.fullmatch(r"\w+", item) else "expr"
+    return alias, e
+
+
+def _parse_expr(s: str, t: Table):
+    s = s.strip()
+    m = re.match(r"(?is)^(\w+)\((.*)\)$", s)
+    if m and m.group(1).lower() in _AGGS:
+        inner = m.group(2).strip()
+        args = [] if inner in ("", "*") else [_parse_expr(inner, t)]
+        return _AGGS[m.group(1).lower()](args)
+    # comparison / arithmetic via python-ish eval over column refs
+    names = set(re.findall(r"[A-Za-z_]\w*", s))
+    env: dict[str, Any] = {}
+    for n in names:
+        if n in t.column_names():
+            env[n] = t[n]
+    py = re.sub(r"(?<![<>!=])=(?!=)", "==", s)
+    py = re.sub(r"(?i)\bAND\b", "&", py)
+    py = re.sub(r"(?i)\bOR\b", "|", py)
+    py = re.sub(r"(?i)\bNOT\b", "~", py)
+    return eval(py, {"__builtins__": {}}, env)  # noqa: S307 — restricted namespace

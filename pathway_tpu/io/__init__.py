@@ -1,0 +1,11 @@
+"""I/O connectors (reference: ``python/pathway/io/`` — 30 modules, each building a
+DataStorage/DataFormat descriptor; here each module wires source/sink engine nodes
+directly)."""
+
+from pathway_tpu.io import csv, fs, http, jsonlines, plaintext, python
+from pathway_tpu.io._subscribe import subscribe
+from pathway_tpu.io.null import write as _null_write
+
+__all__ = ["csv", "fs", "http", "jsonlines", "plaintext", "python", "subscribe", "null"]
+
+from pathway_tpu.io import null  # noqa: E402

@@ -1,0 +1,19 @@
+"""``pw.io.subscribe`` (reference: ``python/pathway/io/_subscribe.py``)."""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+
+def subscribe(
+    table: Any,
+    on_change: Callable,
+    on_end: Callable | None = None,
+    on_time_end: Callable | None = None,
+    *,
+    name: str | None = None,
+) -> None:
+    """Calls ``on_change(key, row, time, is_addition)`` for every change,
+    ``on_time_end(time)`` at the end of each logical time, ``on_end()`` on close."""
+    node = table._subscribe_node(on_change=on_change, on_time_end=on_time_end, on_end=on_end)
+    node._register_as_output()

@@ -1,0 +1,247 @@
+"""Filesystem connector (reference: ``python/pathway/io/fs`` over the Rust
+``posix_like.rs`` + ``scanner/filesystem.rs`` readers and ``FileWriter``).
+
+``mode="static"`` reads matching files once; ``mode="streaming"`` polls the glob for
+new/changed files from a connector thread, emitting rows as they appear (object
+deletions are detected and retracted, mirroring the reference's metadata trackers).
+"""
+
+from __future__ import annotations
+
+import csv as _csv
+import glob as _glob
+import io as _io
+import json as _json
+import os
+import threading
+import time as _time
+from typing import Any
+
+import numpy as np
+
+from pathway_tpu.engine import operators as ops
+from pathway_tpu.engine.blocks import DeltaBatch
+from pathway_tpu.engine.graph import Node
+from pathway_tpu.internals import dtype as dt
+from pathway_tpu.internals import schema as schema_mod
+from pathway_tpu.internals.keys import row_keys, sequential_keys, splitmix64
+from pathway_tpu.internals.logical import LogicalNode
+from pathway_tpu.internals.table import Table, table_from_static_data
+from pathway_tpu.internals.universe import Universe
+
+
+def _list_files(path: str) -> list[str]:
+    if os.path.isdir(path):
+        out = []
+        for root, _dirs, files in os.walk(path):
+            out.extend(os.path.join(root, f) for f in sorted(files))
+        return sorted(out)
+    return sorted(_glob.glob(path))
+
+
+def _coerce(tok: str, d: dt.DType) -> Any:
+    d = dt.unoptionalize(d)
+    try:
+        if d == dt.INT:
+            return int(tok)
+        if d == dt.FLOAT:
+            return float(tok)
+        if d == dt.BOOL:
+            return tok.strip().lower() in ("true", "1", "yes", "t")
+        if d == dt.JSON:
+            from pathway_tpu.internals.json import Json
+
+            return Json(_json.loads(tok))
+        return tok
+    except (ValueError, TypeError):
+        from pathway_tpu.internals.errors import ERROR
+
+        return ERROR
+
+
+def _parse_file(
+    fpath: str, fmt: str, schema: schema_mod.SchemaMetaclass, csv_settings: Any = None
+) -> list[tuple]:
+    cols = schema.column_names()
+    dtypes = schema.dtypes()
+    rows: list[tuple] = []
+    if fmt in ("plaintext", "plaintext_by_file"):
+        with open(fpath, "r", errors="replace") as f:
+            if fmt == "plaintext_by_file":
+                return [(f.read(),)]
+            return [(line.rstrip("\n"),) for line in f]
+    if fmt == "binary":
+        with open(fpath, "rb") as f:
+            return [(f.read(),)]
+    if fmt == "csv":
+        with open(fpath, "r", newline="", errors="replace") as f:
+            reader = _csv.DictReader(f)
+            for rec in reader:
+                rows.append(tuple(_coerce(rec.get(c, ""), dtypes[c]) for c in cols))
+        return rows
+    if fmt in ("json", "jsonlines"):
+        from pathway_tpu.internals.json import Json
+
+        with open(fpath, "r", errors="replace") as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                rec = _json.loads(line)
+                row = []
+                for c in cols:
+                    v = rec.get(c)
+                    d = dt.unoptionalize(dtypes[c])
+                    if d == dt.JSON and not isinstance(v, Json):
+                        v = Json(v)
+                    row.append(v)
+                rows.append(tuple(row))
+        return rows
+    raise ValueError(f"unknown format {fmt!r}")
+
+
+def _keys_for(
+    rows: list[tuple], schema: schema_mod.SchemaMetaclass, salt: int
+) -> list[int]:
+    pks = schema.primary_key_columns()
+    cols = schema.column_names()
+    if pks:
+        arrays = []
+        for pk in pks:
+            i = cols.index(pk)
+            a = np.empty(len(rows), dtype=object)
+            a[:] = [r[i] for r in rows]
+            arrays.append(a)
+        return [int(k) for k in row_keys(arrays, n=len(rows))]
+    return [int(k) for k in sequential_keys(0, len(rows), salt=salt)]
+
+
+def read(
+    path: str,
+    *,
+    format: str = "csv",  # noqa: A002
+    schema: schema_mod.SchemaMetaclass | None = None,
+    mode: str = "streaming",
+    csv_settings: Any = None,
+    autocommit_duration_ms: int | None = None,
+    with_metadata: bool = False,
+    name: str | None = None,
+    **kwargs: Any,
+) -> Table:
+    if schema is None:
+        if format in ("plaintext", "plaintext_by_file"):
+            schema = schema_mod.schema_from_types(data=str)
+        elif format == "binary":
+            schema = schema_mod.schema_from_types(data=bytes)
+        else:
+            raise ValueError("schema required for csv/json formats")
+    if with_metadata:
+        schema = schema | schema_mod.schema_from_types(_metadata=dict)
+
+    if mode == "static":
+        all_rows: list[tuple] = []
+        for fpath in _list_files(path):
+            rows = _parse_file(fpath, format, schema, csv_settings)
+            if with_metadata:
+                rows = [r + (_metadata_for(fpath),) for r in rows]
+            all_rows.extend(rows)
+        keys = _keys_for(all_rows, schema, salt=hash(path) & 0xFFFF)
+        return table_from_static_data(keys, all_rows, schema)
+
+    # streaming: poll directory from a connector thread
+    from pathway_tpu.io.python import ConnectorSubject, read as py_read
+
+    class _FsSubject(ConnectorSubject):
+        def __init__(self) -> None:
+            super().__init__()
+            self._seen: dict[str, float] = {}
+            self._stop = False
+            self._bounded = kwargs.get("_bounded", False)
+
+        def run(self) -> None:
+            while not self._stop:
+                found = False
+                for fpath in _list_files(path):
+                    mtime = os.path.getmtime(fpath)
+                    if self._seen.get(fpath) == mtime:
+                        continue
+                    self._seen[fpath] = mtime
+                    found = True
+                    for r in _parse_file(fpath, format, schema, csv_settings):
+                        if with_metadata:
+                            r = r + (_metadata_for(fpath),)
+                        self.next(**dict(zip(schema.column_names(), r)))
+                if self._bounded and not found:
+                    return
+                _time.sleep(0.05)
+
+        def on_stop(self) -> None:
+            self._stop = True
+
+    return py_read(_FsSubject(), schema=schema, name=name or f"fs:{path}")
+
+
+def _metadata_for(fpath: str) -> Any:
+    from pathway_tpu.internals.json import Json
+
+    st = os.stat(fpath)
+    return Json(
+        {
+            "path": os.path.abspath(fpath),
+            "size": st.st_size,
+            "modified_at": int(st.st_mtime),
+            "seen_at": int(_time.time()),
+        }
+    )
+
+
+def write(table: Table, filename: str, *, format: str = "csv", **kwargs: Any) -> None:  # noqa: A002
+    """Append output diffs to a file with time/diff columns (reference FileWriter +
+    DsvFormatter/JsonLinesFormatter semantics)."""
+    cols = table.column_names()
+    lock = threading.Lock()
+    fh = open(filename, "w", newline="")
+    if format == "csv":
+        writer = _csv.writer(fh)
+        writer.writerow(cols + ["time", "diff"])
+
+        def on_batch(batch: DeltaBatch, columns: list[str]) -> None:
+            with lock:
+                for key, diff, row in batch.rows():
+                    writer.writerow(list(row) + [batch.time, diff])
+                fh.flush()
+
+    elif format in ("json", "jsonlines"):
+
+        def on_batch(batch: DeltaBatch, columns: list[str]) -> None:
+            from pathway_tpu.internals.json import Json
+
+            with lock:
+                for key, diff, row in batch.rows():
+                    rec = {}
+                    for c, v in zip(columns, row):
+                        if isinstance(v, Json):
+                            v = v.value
+                        elif isinstance(v, (np.generic,)):
+                            v = v.item()
+                        elif isinstance(v, tuple):
+                            v = list(v)
+                        rec[c] = v
+                    rec["time"] = batch.time
+                    rec["diff"] = diff
+                    fh.write(_json.dumps(rec) + "\n")
+                fh.flush()
+
+    else:
+        raise ValueError(f"unknown format {format!r}")
+
+    def on_done() -> None:
+        with lock:
+            fh.flush()
+            fh.close()
+
+    LogicalNode(
+        lambda: ops.CallbackOutputNode(cols, on_batch, on_done),
+        [table._node],
+        name=f"fs_write:{filename}",
+    )._register_as_output()

@@ -1,0 +1,10 @@
+"""HTTP connector & REST request/response server.
+
+Reference: ``python/pathway/io/http`` — ``rest_connector`` (``_server.py:624``) is
+the request/response bridge that makes streaming RAG servers possible. Implemented
+in this package in ``_server.py`` on aiohttp.
+"""
+
+from pathway_tpu.io.http._server import PathwayWebserver, rest_connector, response_writer
+
+__all__ = ["rest_connector", "response_writer", "PathwayWebserver"]

@@ -1,0 +1,220 @@
+"""REST request/response connector.
+
+Mirrors the reference's ``python/pathway/io/http/_server.py`` (``PathwayWebserver``
+aiohttp server ``:329``, ``rest_connector`` ``:624``, ``RestServerSubject`` ``:490``):
+an HTTP request becomes a row in a streaming queries table (keyed by a request id);
+the paired ``response_writer`` subscribes to a result table and resolves the stored
+future for that id, completing the HTTP response. Queries are append-only ("as-of-now"
+discipline) — results for a request are served once and not retracted.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json as _json
+import threading
+from typing import Any
+
+import numpy as np
+
+from pathway_tpu.engine import operators as ops
+from pathway_tpu.engine.graph import Node
+from pathway_tpu.internals import dtype as dt
+from pathway_tpu.internals import schema as schema_mod
+from pathway_tpu.internals.json import Json
+from pathway_tpu.internals.keys import splitmix64
+from pathway_tpu.internals.logical import LogicalNode
+from pathway_tpu.internals.table import Table
+from pathway_tpu.internals.universe import Universe
+
+
+def _jsonable(v: Any) -> Any:
+    if isinstance(v, Json):
+        return v.value
+    if isinstance(v, np.generic):
+        return v.item()
+    if isinstance(v, (tuple, list)):
+        return [_jsonable(x) for x in v]
+    if isinstance(v, np.ndarray):
+        return v.tolist()
+    if isinstance(v, dict):
+        return {k: _jsonable(x) for k, x in v.items()}
+    return v
+
+
+class PathwayWebserver:
+    """One aiohttp server shared by many rest_connector routes
+    (reference ``_server.py:329``)."""
+
+    def __init__(self, host: str = "0.0.0.0", port: int = 8080, with_cors: bool = False):
+        self.host = host
+        self.port = port
+        self.with_cors = with_cors
+        self._routes: list[tuple[str, list[str], Any]] = []
+        self._started = threading.Event()
+        self._loop: asyncio.AbstractEventLoop | None = None
+        self._thread: threading.Thread | None = None
+        self._runner = None
+
+    def _add_route(self, route: str, methods: list[str], handler: Any) -> None:
+        self._routes.append((route, methods, handler))
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+
+        import aiohttp.web as web
+
+        app = web.Application()
+        for route, methods, handler in self._routes:
+            for m in methods:
+                app.router.add_route(m, route, handler)
+
+        def serve() -> None:
+            loop = asyncio.new_event_loop()
+            asyncio.set_event_loop(loop)
+            self._loop = loop
+            runner = web.AppRunner(app)
+            loop.run_until_complete(runner.setup())
+            site = web.TCPSite(runner, self.host, self.port)
+            loop.run_until_complete(site.start())
+            self._runner = runner
+            self._started.set()
+            loop.run_forever()
+            loop.run_until_complete(runner.cleanup())
+
+        self._thread = threading.Thread(target=serve, daemon=True)
+        self._thread.start()
+        self._started.wait(timeout=10)
+
+    def stop(self) -> None:
+        if self._loop is not None:
+            self._loop.call_soon_threadsafe(self._loop.stop)
+
+
+class _RestDriver:
+    """Connector driver: the server lives for the duration of the run."""
+
+    virtual = False
+
+    def __init__(self, webserver: PathwayWebserver):
+        self.webserver = webserver
+
+    def start(self) -> None:
+        self.webserver.start()
+
+    def is_finished(self) -> bool:
+        return False  # unbounded; stopped via runtime.request_stop()
+
+    def stop(self) -> None:
+        self.webserver.stop()
+
+
+class _RestState:
+    def __init__(self) -> None:
+        self.node: ops.StreamInputNode | None = None
+        self.futures: dict[int, asyncio.Future] = {}
+        self.seq = 0
+        self.lock = threading.Lock()
+
+
+def rest_connector(
+    host: str = "0.0.0.0",
+    port: int = 8080,
+    *,
+    webserver: PathwayWebserver | None = None,
+    route: str = "/",
+    schema: schema_mod.SchemaMetaclass | None = None,
+    methods: tuple[str, ...] = ("POST",),
+    autocommit_duration_ms: int | None = 20,
+    keep_queries: bool = False,
+    delete_completed_queries: bool | None = None,
+    request_validator: Any = None,
+    documentation: Any = None,
+) -> tuple[Table, Any]:
+    """Returns ``(queries_table, response_writer)``."""
+    ws = webserver or PathwayWebserver(host=host, port=port)
+    if schema is None:
+        schema = schema_mod.schema_from_types(query=str)
+    columns = schema.column_names()
+    np_dtypes = schema.np_dtypes()
+    dtypes = schema.dtypes()
+    state = _RestState()
+
+    import aiohttp.web as web
+
+    async def handler(request: "web.Request") -> "web.Response":
+        if request.method == "GET":
+            payload = dict(request.rel_url.query)
+        else:
+            try:
+                payload = await request.json()
+            except Exception:
+                payload = {"query": await request.text()}
+        if request_validator is not None:
+            try:
+                request_validator(payload)
+            except Exception as e:
+                return web.json_response({"error": str(e)}, status=400)
+        values = []
+        for c in columns:
+            v = payload.get(c)
+            d = dt.unoptionalize(dtypes[c])
+            if d == dt.JSON and v is not None and not isinstance(v, Json):
+                v = Json(v)
+            values.append(v)
+        with state.lock:
+            state.seq += 1
+            key = int(splitmix64(np.asarray([state.seq], dtype=np.uint64))[0])
+        fut: asyncio.Future = asyncio.get_running_loop().create_future()
+        state.futures[key] = fut
+        assert state.node is not None, "rest_connector: engine not running"
+        state.node.push(key, tuple(values), 1)
+        try:
+            result = await asyncio.wait_for(fut, timeout=120)
+        except asyncio.TimeoutError:
+            state.futures.pop(key, None)
+            return web.json_response({"error": "timeout"}, status=504)
+        return web.json_response(_jsonable(result))
+
+    ws._add_route(route, list(methods), handler)
+
+    def factory() -> Node:
+        node = ops.StreamInputNode(columns, np_dtypes)
+        state.node = node
+        return node
+
+    def hook(node: Node, runtime: Any) -> None:
+        if runtime is not None:
+            runtime.register_connector(_RestDriver(ws))
+
+    lnode = LogicalNode(factory, [], name=f"rest:{route}", runtime_hook=hook)
+    queries = Table(lnode, schema, Universe())
+
+    def response_writer(result_table: Table) -> None:
+        cols = result_table.column_names()
+
+        def on_change(key: int, row: dict, time: int, is_addition: bool) -> None:
+            if not is_addition:
+                return
+            fut = state.futures.pop(int(key), None)
+            if fut is None:
+                return
+            if "result" in row and len(cols) <= 2:
+                value = row["result"]
+            else:
+                value = row
+            loop = fut.get_loop()
+            loop.call_soon_threadsafe(
+                lambda: fut.set_result(value) if not fut.done() else None
+            )
+
+        from pathway_tpu.io._subscribe import subscribe
+
+        subscribe(result_table, on_change)
+
+    return queries, response_writer
+
+
+def response_writer(*args: Any, **kwargs: Any) -> None:
+    raise RuntimeError("use the response_writer returned by rest_connector")

@@ -1,0 +1,229 @@
+"""Python connector: ``pw.io.python.read`` + ``ConnectorSubject``.
+
+Mirrors the reference's ``python/pathway/io/python/__init__.py:47``
+(``ConnectorSubject``: a user thread pushing rows through a queue into the engine —
+Rust side ``PythonReader`` at ``src/connectors/data_storage.rs:927``). Here the
+subject pushes directly into a ``StreamInputNode``; the run loop stamps whatever
+arrived between autocommit ticks with the next logical time.
+"""
+
+from __future__ import annotations
+
+import json as _json
+import threading
+from typing import Any
+
+import numpy as np
+
+from pathway_tpu.engine import operators as ops
+from pathway_tpu.engine.graph import Node
+from pathway_tpu.internals import schema as schema_mod
+from pathway_tpu.internals.keys import row_keys, splitmix64
+from pathway_tpu.internals.logical import LogicalNode
+from pathway_tpu.internals.table import Table
+from pathway_tpu.internals.universe import Universe
+
+
+class ConnectorSubject:
+    """Subclass and implement ``run()`` calling ``self.next(**kwargs)``."""
+
+    def __init__(self, datasource_name: str = "python"):
+        self._node: ops.StreamInputNode | None = None
+        self._columns: list[str] = []
+        self._pk_cols: list[str] | None = None
+        self._seq = 0
+        self._closed = False
+        self._started = threading.Event()
+
+    # ---- user API ----
+    def run(self) -> None:
+        raise NotImplementedError
+
+    def next(self, **kwargs: Any) -> None:
+        values = tuple(kwargs.get(c) for c in self._columns)
+        self._push(values, diff=1)
+
+    def next_json(self, data: dict) -> None:
+        self.next(**data)
+
+    def next_str(self, line: str) -> None:
+        self.next(data=line)
+
+    def next_bytes(self, data: bytes) -> None:
+        self.next(data=data)
+
+    def commit(self) -> None:
+        pass  # ticks auto-commit; kept for API parity
+
+    def close(self) -> None:
+        self._closed = True
+
+    def on_stop(self) -> None:
+        pass
+
+    @property
+    def _session_type(self) -> str:
+        return "native"
+
+    # ---- internals ----
+    def _key_of(self, values: tuple) -> int:
+        if self._pk_cols:
+            idx = [self._columns.index(c) for c in self._pk_cols]
+            arrs = []
+            for i in idx:
+                a = np.empty(1, dtype=object)
+                a[0] = values[i]
+                arrs.append(a)
+            return int(row_keys(arrs, n=1)[0])
+        self._seq += 1
+        return int(splitmix64(np.asarray([self._seq], dtype=np.uint64))[0])
+
+    def _push(self, values: tuple, diff: int) -> None:
+        assert self._node is not None, "subject not attached to a running graph"
+        self._node.push(self._key_of(values), values, diff)
+
+    def _remove(self, **kwargs: Any) -> None:
+        if not self._pk_cols:
+            raise RuntimeError("_remove requires a schema with primary keys")
+        values = tuple(kwargs.get(c) for c in self._columns)
+        self._node.push(self._key_of(values), values, -1)
+
+
+class _SubjectDriver:
+    """Runs the subject's ``run()`` in a thread (reference: connector thread per
+    input, ``src/connectors/mod.rs:91``)."""
+
+    virtual = False
+
+    def __init__(self, subject: ConnectorSubject):
+        self.subject = subject
+        self.thread: threading.Thread | None = None
+
+    def start(self) -> None:
+        def target() -> None:
+            try:
+                self.subject.run()
+            finally:
+                self.subject.close()
+
+        self.thread = threading.Thread(target=target, daemon=True)
+        self.thread.start()
+
+    def is_finished(self) -> bool:
+        node = self.subject._node
+        return (
+            self.subject._closed
+            and (self.thread is None or not self.thread.is_alive())
+            and (node is None or not node._pending)
+        )
+
+    def stop(self) -> None:
+        self.subject.on_stop()
+
+
+class _StaticStreamSubject(ConnectorSubject):
+    """Deterministic timed fixture: events = [(time, key, values, diff)].
+
+    Plays the role of the reference's ``pw.debug.StreamGenerator``
+    (``debug/__init__.py:508``): exact logical times, no real threads.
+    """
+
+    def __init__(self, events: list[tuple[int, int, tuple, int]], columns: list[str]):
+        super().__init__()
+        self.events = events
+        self._columns = columns
+
+    def run(self) -> None:
+        pass
+
+
+class _TimedInputNode(ops.StreamInputNode):
+    """Input node emitting pre-timed events when the tick reaches their time."""
+
+    def __init__(self, events, columns, np_dtypes, upsert=False):
+        super().__init__(columns, np_dtypes, upsert=upsert)
+        self.events = events  # sorted by time
+        self.idx = 0
+
+    def poll(self, time: int):
+        from pathway_tpu.engine.graph import END_OF_STREAM
+
+        emit_until = self.idx
+        while emit_until < len(self.events) and (
+            self.events[emit_until][0] <= time or time == END_OF_STREAM
+        ):
+            emit_until += 1
+        if emit_until == self.idx:
+            return []
+        for t, key, values, diff in self.events[self.idx : emit_until]:
+            self.push(key, values, diff)
+        self.idx = emit_until
+        return super().poll(time)
+
+    @property
+    def max_time(self) -> int:
+        return self.events[-1][0] if self.events else 0
+
+
+class _TimedDriver:
+    virtual = True
+
+    def __init__(self, node_holder: dict):
+        self.holder = node_holder
+
+    def start(self) -> None:
+        pass
+
+    def is_finished(self) -> bool:
+        node = self.holder.get("node")
+        return node is not None and node.idx >= len(node.events) and not node._pending
+
+    def stop(self) -> None:
+        pass
+
+
+def read(
+    subject: ConnectorSubject,
+    *,
+    schema: schema_mod.SchemaMetaclass,
+    autocommit_duration_ms: int | None = None,
+    name: str | None = None,
+    **kwargs: Any,
+) -> Table:
+    columns = schema.column_names()
+    np_dtypes = schema.np_dtypes()
+    subject._columns = columns
+    subject._pk_cols = schema.primary_key_columns()
+
+    if isinstance(subject, _StaticStreamSubject):
+        holder: dict[str, Any] = {}
+        events = subject.events
+
+        def factory() -> Node:
+            node = _TimedInputNode(events, columns, np_dtypes)
+            holder["node"] = node
+            return node
+
+        def hook(node: Node, runtime: Any) -> None:
+            if runtime is not None:
+                runtime.register_connector(_TimedDriver(holder))
+
+        lnode = LogicalNode(factory, [], name=name or "stream_fixture", runtime_hook=hook)
+        return Table(lnode, schema, Universe())
+
+    def factory() -> Node:
+        node = ops.StreamInputNode(
+            columns, np_dtypes, upsert=subject._session_type == "upsert"
+        )
+        subject._node = node
+        return node
+
+    def hook(node: Node, runtime: Any) -> None:
+        if runtime is not None:
+            runtime.register_connector(_SubjectDriver(subject))
+
+    lnode = LogicalNode(factory, [], name=name or "python_connector", runtime_hook=hook)
+    return Table(lnode, schema, Universe())
+
+
+read_subject = read

@@ -1,0 +1,53 @@
+"""Persistence config & backends (reference: ``python/pathway/persistence/__init__.py``
++ ``src/persistence/``). Input snapshots + offsets land with the persistence
+milestone; the config surface is stable from day one."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any
+
+
+class Backend:
+    kind: str = "memory"
+    path: str | None = None
+
+    def __init__(self, kind: str, path: str | None = None, **kwargs: Any):
+        self.kind = kind
+        self.path = path
+        self.extra = kwargs
+
+    @classmethod
+    def filesystem(cls, path: str) -> "Backend":
+        return cls("filesystem", path)
+
+    @classmethod
+    def s3(cls, root_path: str, bucket_settings: Any = None) -> "Backend":
+        raise NotImplementedError("s3 persistence backend requires object-store access")
+
+    @classmethod
+    def mock(cls, events: Any = None) -> "Backend":
+        return cls("mock")
+
+    @classmethod
+    def memory(cls) -> "Backend":
+        return cls("memory")
+
+
+@dataclass
+class Config:
+    backend: Backend
+    snapshot_interval_ms: int = 0
+    persistence_mode: str = "persisting"
+    snapshot_access: str = "full"
+    continue_after_replay: bool = True
+
+    @classmethod
+    def simple_config(cls, backend: Backend, **kwargs: Any) -> "Config":
+        return cls(backend=backend, **kwargs)
+
+
+def attach_persistence(runtime: Any, config: Config) -> None:
+    from pathway_tpu.persistence.snapshots import attach
+
+    attach(runtime, config)

@@ -1,0 +1,24 @@
+"""Standard library (reference: ``python/pathway/stdlib/`` — temporal, indexing, ml,
+graphs, stateful, ordered, statistical, utils, viz)."""
+
+from pathway_tpu.stdlib import (
+    graphs,
+    indexing,
+    ml,
+    ordered,
+    stateful,
+    statistical,
+    temporal,
+    utils,
+)
+
+__all__ = [
+    "graphs",
+    "indexing",
+    "ml",
+    "ordered",
+    "stateful",
+    "statistical",
+    "temporal",
+    "utils",
+]

@@ -1,0 +1,11 @@
+"""Temporal operations (reference: ``python/pathway/stdlib/temporal/``).
+
+Windows, behaviors, interval/asof joins land in the temporal milestone; this module
+keeps the import surface stable.
+"""
+
+def __getattr__(name):
+    from pathway_tpu.stdlib.temporal import _impl
+    if hasattr(_impl, name):
+        return getattr(_impl, name)
+    raise AttributeError(name)

@@ -1,0 +1,22 @@
+"""Test configuration.
+
+Multi-chip sharding tests run on a virtual 8-device CPU mesh (the reference tests
+multi-worker the same way — N local processes on loopback,
+``integration_tests/wordcount/conftest.py``): set platform env BEFORE jax imports.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _fresh_graph():
+    from pathway_tpu.internals.parse_graph import G
+
+    G.clear()
+    yield
+    G.clear()

@@ -1,0 +1,94 @@
+"""dtype lattice, key hashing, schema (reference behaviors: dtype.py / schema.py
+unification & column defs)."""
+
+import numpy as np
+import pytest
+
+import pathway_tpu as pw
+from pathway_tpu.internals import dtype as dt
+from pathway_tpu.internals import keys
+
+
+def test_wrap_basic():
+    assert dt.wrap(int) == dt.INT
+    assert dt.wrap(float) == dt.FLOAT
+    assert dt.wrap(str) == dt.STR
+    assert dt.wrap(bool) == dt.BOOL
+    assert dt.wrap(bytes) == dt.BYTES
+    assert dt.wrap(int | None) == dt.Optional(dt.INT)
+    assert dt.wrap(tuple[int, str]) == dt.Tuple(dt.INT, dt.STR)
+    assert dt.wrap(list[int]) == dt.List(dt.INT)
+    assert dt.wrap(np.ndarray) == dt.ANY_ARRAY
+
+
+def test_optional_collapse():
+    assert dt.Optional(dt.Optional(dt.INT)) == dt.Optional(dt.INT)
+    assert dt.Optional(dt.ANY) == dt.ANY
+    assert dt.Optional(dt.NONE) == dt.NONE
+
+
+def test_subtype():
+    assert dt.is_subtype(dt.INT, dt.FLOAT)
+    assert dt.is_subtype(dt.INT, dt.Optional(dt.INT))
+    assert dt.is_subtype(dt.NONE, dt.Optional(dt.STR))
+    assert not dt.is_subtype(dt.FLOAT, dt.INT)
+    assert dt.is_subtype(dt.Tuple(dt.INT, dt.STR), dt.ANY_TUPLE)
+
+
+def test_lca():
+    assert dt.types_lca(dt.INT, dt.FLOAT) == dt.FLOAT
+    assert dt.types_lca(dt.INT, dt.NONE) == dt.Optional(dt.INT)
+    assert dt.types_lca(dt.STR, dt.INT) == dt.ANY
+    assert dt.types_lca(dt.Optional(dt.INT), dt.FLOAT) == dt.Optional(dt.FLOAT)
+
+
+def test_key_hash_deterministic_and_vectorized():
+    a = np.array([1, 2, 3], dtype=np.int64)
+    h1 = keys.hash_column(a)
+    h2 = keys.hash_column(a.copy())
+    assert (h1 == h2).all()
+    assert len(set(h1.tolist())) == 3
+    # scalar path consistent with vector path
+    s = keys.row_keys([np.array(["x", "y"], dtype=object)], n=2)
+    assert s[0] == keys.ref_scalar("x")
+    assert s[1] == keys.ref_scalar("y")
+
+
+def test_key_hash_int_float_equal():
+    hi = keys.hash_column(np.array([1.0, 2.0]))
+    hj = keys.hash_column(np.array([1.0, 2.0]))
+    assert (hi == hj).all()
+
+
+def test_shard_bits():
+    k = keys.sequential_keys(0, 1000)
+    shards = keys.shard_of(k)
+    assert shards.min() >= 0 and shards.max() < (1 << keys.SHARD_BITS)
+
+
+def test_schema_class():
+    class S(pw.Schema):
+        name: str
+        age: int = pw.column_definition(primary_key=True)
+        score: float = pw.column_definition(default_value=0.0)
+
+    assert S.column_names() == ["name", "age", "score"]
+    assert S.dtypes()["age"] == dt.INT
+    assert S.primary_key_columns() == ["age"]
+    assert S.default_values() == {"score": 0.0}
+
+
+def test_schema_algebra():
+    A = pw.schema_from_types(x=int, y=str)
+    B = pw.schema_from_types(z=float)
+    C = A | B
+    assert set(C.column_names()) == {"x", "y", "z"}
+    D = C.without("y")
+    assert set(D.column_names()) == {"x", "z"}
+    E = A.update_types(x=float)
+    assert E.dtypes()["x"] == dt.FLOAT
+
+
+def test_schema_from_dict():
+    S = pw.schema_from_dict({"a": int, "b": {"dtype": str, "primary_key": True}})
+    assert S.primary_key_columns() == ["b"]

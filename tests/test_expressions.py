@@ -1,0 +1,235 @@
+"""Expression surface: arithmetic, comparisons, if_else/coalesce, apply, casts,
+str/dt namespaces (reference: test_common.py expression behaviors +
+engine/expression.rs op coverage)."""
+
+import datetime
+
+import numpy as np
+import pytest
+
+import pathway_tpu as pw
+from tests.utils import assert_rows
+
+
+def t_nums():
+    return pw.debug.table_from_markdown(
+        """
+        a | b
+        1 | 10
+        2 | 20
+        3 | 30
+        """
+    )
+
+
+def test_arithmetic():
+    t = t_nums().select(
+        s=pw.this.a + pw.this.b,
+        d=pw.this.b - pw.this.a,
+        m=pw.this.a * pw.this.b,
+        q=pw.this.b / pw.this.a,
+        fd=pw.this.b // pw.this.a,
+        mod=pw.this.b % pw.this.a,
+        p=pw.this.a**2,
+        neg=-pw.this.a,
+    )
+    assert_rows(
+        t,
+        [
+            (11, 9, 10, 10.0, 10, 0, 1, -1),
+            (22, 18, 40, 10.0, 10, 0, 4, -2),
+            (33, 27, 90, 10.0, 10, 0, 9, -3),
+        ],
+    )
+
+
+def test_division_by_zero_is_error():
+    t = pw.debug.table_from_markdown(
+        """
+        a | b
+        6 | 2
+        5 | 0
+        """
+    ).select(q=pw.this.a // pw.this.b)
+    rows = list(__import__("tests.utils", fromlist=["rows_of"]).rows_of(t).keys())
+    vals = {r[0] for r in rows}
+    assert 3 in vals
+    from pathway_tpu.internals.errors import ERROR
+
+    assert ERROR in vals
+
+
+def test_comparisons_and_bool():
+    t = t_nums().select(
+        gt=pw.this.a > 1,
+        both=(pw.this.a > 1) & (pw.this.b < 30),
+        either=(pw.this.a == 1) | (pw.this.b == 30),
+        inv=~(pw.this.a == 2),
+    )
+    assert_rows(
+        t,
+        [
+            (False, False, True, True),
+            (True, True, False, False),
+            (True, False, True, True),
+        ],
+    )
+
+
+def test_if_else_coalesce():
+    t = pw.debug.table_from_markdown(
+        """
+        a    | b
+        1    | 5
+        None | 7
+        """
+    ).select(
+        c=pw.coalesce(pw.this.a, pw.this.b),
+        i=pw.if_else(pw.this.b > 6, 100, 200),
+    )
+    assert_rows(t, [(1, 200), (7, 100)])
+
+
+def test_apply():
+    t = t_nums().select(x=pw.apply(lambda a, b: a * 100 + b, pw.this.a, pw.this.b))
+    assert_rows(t, [(110,), (220,), (330,)])
+
+
+def test_apply_with_type_and_exceptions():
+    def boom(a: int) -> int:
+        if a == 2:
+            raise ValueError("no")
+        return a
+
+    t = t_nums().select(x=pw.apply(boom, pw.this.a))
+    from pathway_tpu.internals.errors import ERROR
+    from tests.utils import rows_of
+
+    vals = {r[0] for r in rows_of(t)}
+    assert vals == {1, ERROR, 3}
+
+
+def test_cast():
+    t = t_nums().select(
+        f=pw.cast(float, pw.this.a),
+        s=pw.cast(str, pw.this.a),
+        i=pw.cast(int, pw.this.a / pw.this.a + 0.9),
+    )
+    assert_rows(t, [(1.0, "1", 1), (2.0, "2", 1), (3.0, "3", 1)])
+
+
+def test_str_namespace():
+    t = pw.debug.table_from_markdown(
+        """
+        s
+        Hello
+        world
+        """
+    ).select(
+        up=pw.this.s.str.upper(),
+        lo=pw.this.s.str.lower(),
+        ln=pw.this.s.str.len(),
+        sw=pw.this.s.str.startswith("H"),
+        rev=pw.this.s.str.reversed(),
+        rep=pw.this.s.str.replace("l", "L"),
+    )
+    assert_rows(
+        t,
+        [
+            ("HELLO", "hello", 5, True, "olleH", "HeLLo"),
+            ("WORLD", "world", 5, False, "dlrow", "worLd"),
+        ],
+    )
+
+
+def test_parse_and_to_string():
+    t = pw.debug.table_from_markdown(
+        """
+        s
+        '1'
+        '2'
+        """
+    ).select(i=pw.this.s.str.parse_int(), s2=pw.this.s.str.parse_int().to_string())
+    assert_rows(t, [(1, "1"), (2, "2")])
+
+
+def test_dt_namespace():
+    t = pw.debug.table_from_markdown(
+        """
+        ts
+        '2023-03-01 11:22:33'
+        """
+    ).select(d=pw.this.ts.dt.strptime("%Y-%m-%d %H:%M:%S"))
+    t2 = t.select(
+        y=pw.this.d.dt.year(),
+        mo=pw.this.d.dt.month(),
+        dd=pw.this.d.dt.day(),
+        h=pw.this.d.dt.hour(),
+        mi=pw.this.d.dt.minute(),
+        s=pw.this.d.dt.second(),
+        fmt=pw.this.d.dt.strftime("%Y/%m/%d"),
+    )
+    assert_rows(t2, [(2023, 3, 1, 11, 22, 33, "2023/03/01")])
+
+
+def test_duration_ops():
+    t = pw.debug.table_from_markdown(
+        """
+        a                     | b
+        '2023-03-01 10:00:00' | '2023-03-01 12:30:00'
+        """
+    ).select(
+        a=pw.this.a.dt.strptime("%Y-%m-%d %H:%M:%S"),
+        b=pw.this.b.dt.strptime("%Y-%m-%d %H:%M:%S"),
+    )
+    t2 = t.select(
+        mins=(pw.this.b - pw.this.a).dt.minutes(),
+        secs=(pw.this.b - pw.this.a).dt.seconds(),
+    )
+    assert_rows(t2, [(150, 9000)])
+
+
+def test_make_tuple_and_get():
+    t = t_nums().select(tup=pw.make_tuple(pw.this.a, pw.this.b))
+    t2 = t.select(x=pw.this.tup[0], y=pw.this.tup.get(5, default=-1))
+    assert_rows(t2, [(1, -1), (2, -1), (3, -1)])
+
+
+def test_pointer_from_consistency():
+    t = t_nums()
+    t2 = t.select(p=t.pointer_from(pw.this.a))
+    reindexed = t.with_id_from(pw.this.a)
+    from tests.utils import keyed_rows_of, rows_of
+
+    ptrs = {r[0] for r in rows_of(t2)}
+    ids = set(keyed_rows_of(reindexed).keys())
+    assert ptrs == ids
+
+
+def test_is_none_and_unwrap():
+    t = pw.debug.table_from_markdown(
+        """
+        a
+        1
+        None
+        """
+    ).select(isn=pw.this.a.is_none(), notn=pw.this.a.is_not_none())
+    assert_rows(t, [(False, True), (True, False)])
+
+
+def test_udf_sync():
+    @pw.udf
+    def double(x: int) -> int:
+        return 2 * x
+
+    t = t_nums().select(d=double(pw.this.a))
+    assert_rows(t, [(2,), (4,), (6,)])
+
+
+def test_udf_async():
+    @pw.udf
+    async def adouble(x: int) -> int:
+        return 2 * x
+
+    t = t_nums().select(d=adouble(pw.this.a))
+    assert_rows(t, [(2,), (4,), (6,)])

@@ -1,0 +1,181 @@
+"""Join coverage: inner/left/right/outer, incremental updates, ids
+(reference: tests/test_joins.py)."""
+
+import pytest
+
+import pathway_tpu as pw
+from tests.utils import assert_rows, assert_stream_consistent, rows_of
+
+
+def owners():
+    return pw.debug.table_from_markdown(
+        """
+        owner | pet_kind
+        Alice | dog
+        Bob   | cat
+        Carol | fish
+        """
+    )
+
+
+def kinds():
+    return pw.debug.table_from_markdown(
+        """
+        kind | legs
+        dog  | 4
+        cat  | 4
+        bird | 2
+        """
+    )
+
+
+def test_inner_join():
+    j = owners().join(kinds(), pw.left.pet_kind == pw.right.kind).select(
+        pw.left.owner, pw.right.legs
+    )
+    assert_rows(j, [("Alice", 4), ("Bob", 4)])
+
+
+def test_left_join():
+    j = owners().join_left(kinds(), pw.left.pet_kind == pw.right.kind).select(
+        pw.left.owner, pw.right.legs
+    )
+    assert_rows(j, [("Alice", 4), ("Bob", 4), ("Carol", None)])
+
+
+def test_right_join():
+    j = owners().join_right(kinds(), pw.left.pet_kind == pw.right.kind).select(
+        pw.left.owner, pw.right.legs
+    )
+    assert_rows(j, [("Alice", 4), ("Bob", 4), (None, 2)])
+
+
+def test_outer_join():
+    j = owners().join_outer(kinds(), pw.left.pet_kind == pw.right.kind).select(
+        pw.left.owner, pw.right.kind
+    )
+    assert_rows(
+        j, [("Alice", "dog"), ("Bob", "cat"), ("Carol", None), (None, "bird")]
+    )
+
+
+def test_join_multi_condition():
+    a = pw.debug.table_from_markdown(
+        """
+        x | y | va
+        1 | 1 | p
+        1 | 2 | q
+        """
+    )
+    b = pw.debug.table_from_markdown(
+        """
+        x | y | vb
+        1 | 1 | r
+        1 | 2 | s
+        """
+    )
+    j = a.join(b, pw.left.x == pw.right.x, pw.left.y == pw.right.y).select(
+        pw.left.va, pw.right.vb
+    )
+    assert_rows(j, [("p", "r"), ("q", "s")])
+
+
+def test_join_expression_keys():
+    a = pw.debug.table_from_markdown(
+        """
+        n
+        1
+        2
+        """
+    )
+    b = pw.debug.table_from_markdown(
+        """
+        m | txt
+        2 | two
+        4 | four
+        """
+    )
+    j = a.join(b, pw.left.n * 2 == pw.right.m).select(pw.left.n, pw.right.txt)
+    assert_rows(j, [(1, "two"), (2, "four")])
+
+
+def test_join_this_resolution():
+    j = owners().join(kinds(), pw.left.pet_kind == pw.right.kind).select(
+        pw.this.owner, pw.this.legs
+    )
+    assert_rows(j, [("Alice", 4), ("Bob", 4)])
+
+
+def test_join_select_star():
+    j = owners().join(kinds(), pw.left.pet_kind == pw.right.kind).select(pw.left)
+    assert set(j.column_names()) == {"owner", "pet_kind"}
+
+
+def test_incremental_join_stream():
+    left = pw.debug.table_from_markdown(
+        """
+        k | lv | __time__ | __diff__
+        1 | a  | 2        | 1
+        2 | b  | 4        | 1
+        1 | a  | 8        | -1
+        """
+    )
+    right = pw.debug.table_from_markdown(
+        """
+        k | rv | __time__
+        1 | X  | 2
+        2 | Y  | 6
+        """
+    )
+    j = left.join(right, pw.left.k == pw.right.k).select(pw.left.lv, pw.right.rv)
+    assert_stream_consistent(j)
+    assert_rows(j, [("b", "Y")])
+
+
+def test_left_join_pad_flips_incrementally():
+    left = pw.debug.table_from_markdown(
+        """
+        k | lv | __time__
+        1 | a  | 2
+        """
+    )
+    right = pw.debug.table_from_markdown(
+        """
+        k | rv | __time__
+        1 | X  | 6
+        """
+    )
+    j = left.join_left(right, pw.left.k == pw.right.k).select(pw.left.lv, pw.right.rv)
+    assert_stream_consistent(j)
+    assert_rows(j, [("a", "X")])
+    from tests.utils import deltas_of
+
+    deltas = deltas_of(j)
+    assert ((2, ("a", None)) in [(t, row) for (t, _, d, row) in deltas if d > 0])
+    assert ((6, ("a", None)) in [(t, row) for (t, _, d, row) in deltas if d < 0])
+
+
+def test_join_filter():
+    j = owners().join(kinds(), pw.left.pet_kind == pw.right.kind).filter(
+        pw.right.legs == 4
+    )
+    assert len(rows_of(j.select(pw.left.owner))) == 2
+
+
+def test_join_reduce():
+    r = owners().join(kinds(), pw.left.pet_kind == pw.right.kind).reduce(
+        total_legs=pw.reducers.sum(pw.right.legs)
+    )
+    assert_rows(r, [(8,)])
+
+
+def test_join_id_left():
+    t = owners()
+    j = t.join(kinds(), pw.left.pet_kind == pw.right.kind, id=pw.left.id).select(
+        pw.left.owner
+    )
+    from tests.utils import keyed_rows_of
+
+    jk = keyed_rows_of(j)
+    tk = keyed_rows_of(t)
+    assert set(jk).issubset(set(tk))

@@ -1,0 +1,182 @@
+"""Reducer coverage incl. retractions (reference: tests/test_reducers.py +
+engine/reduce.rs semantics)."""
+
+import numpy as np
+import pytest
+
+import pathway_tpu as pw
+from tests.utils import assert_rows, assert_stream_consistent, deltas_of, rows_of
+
+
+def vals():
+    return pw.debug.table_from_markdown(
+        """
+        g | v
+        a | 3
+        a | 1
+        a | 2
+        b | 5
+        """
+    )
+
+
+def test_basic_reducers():
+    r = vals().groupby(pw.this.g).reduce(
+        pw.this.g,
+        cnt=pw.reducers.count(),
+        s=pw.reducers.sum(pw.this.v),
+        mn=pw.reducers.min(pw.this.v),
+        mx=pw.reducers.max(pw.this.v),
+        av=pw.reducers.avg(pw.this.v),
+    )
+    assert_rows(r, [("a", 3, 6, 1, 3, 2.0), ("b", 1, 5, 5, 5, 5.0)])
+
+
+def test_tuple_reducers():
+    r = vals().groupby(pw.this.g).reduce(
+        pw.this.g,
+        st=pw.reducers.sorted_tuple(pw.this.v),
+        nd=pw.reducers.ndarray(pw.this.v),
+    )
+    rows = {row[0]: row for row in rows_of(r)}
+    assert rows["a"][1] == (1, 2, 3)
+    assert rows["b"][1] == (5,)
+
+
+def test_unique_and_any():
+    t = pw.debug.table_from_markdown(
+        """
+        g | v
+        a | 7
+        a | 7
+        b | 1
+        b | 2
+        """
+    )
+    r = t.groupby(pw.this.g).reduce(pw.this.g, u=pw.reducers.any(pw.this.v))
+    rows = {row[0]: row[1] for row in rows_of(r)}
+    assert rows["a"] == 7
+    assert rows["b"] in (1, 2)
+
+    from pathway_tpu.internals.errors import ERROR
+
+    ru = t.groupby(pw.this.g).reduce(pw.this.g, u=pw.reducers.unique(pw.this.v))
+    rows = {row[0]: row[1] for row in rows_of(ru)}
+    assert rows["a"] == 7
+    assert rows["b"] is ERROR
+
+
+def test_argmin_argmax():
+    t = vals().with_id_from(pw.this.g, pw.this.v)
+    r = t.groupby(pw.this.g).reduce(
+        pw.this.g, lo=pw.reducers.argmin(pw.this.v), hi=pw.reducers.argmax(pw.this.v)
+    )
+    looked = r.select(pw.this.g, lo_v=t.ix(r.lo).v, hi_v=t.ix(r.hi).v)
+    assert_rows(looked, [("a", 1, 3), ("b", 5, 5)])
+
+
+def test_earliest_latest_with_stream():
+    t = pw.debug.table_from_markdown(
+        """
+        g | v | __time__
+        a | 1 | 2
+        a | 2 | 4
+        a | 3 | 6
+        """
+    )
+    r = t.groupby(pw.this.g).reduce(
+        pw.this.g,
+        first=pw.reducers.earliest(pw.this.v),
+        last=pw.reducers.latest(pw.this.v),
+    )
+    assert_rows(r, [("a", 1, 3)])
+
+
+def test_incremental_updates_emit_retractions():
+    t = pw.debug.table_from_markdown(
+        """
+        g | v | __time__ | __diff__
+        a | 1 | 2        | 1
+        a | 2 | 4        | 1
+        a | 1 | 6        | -1
+        """
+    )
+    r = t.groupby(pw.this.g).reduce(pw.this.g, s=pw.reducers.sum(pw.this.v))
+    assert_stream_consistent(r)
+    deltas = deltas_of(r)
+    # final state: sum=2; stream passed through 1 -> 3 -> 2
+    assert_rows(r, [("a", 2)])
+    inserted = [row for (_, _, d, row) in deltas if d > 0]
+    assert ("a", 1) in inserted and ("a", 3) in inserted and ("a", 2) in inserted
+
+
+def test_group_disappears_on_full_retraction():
+    t = pw.debug.table_from_markdown(
+        """
+        g | v | __time__ | __diff__
+        a | 1 | 2        | 1
+        a | 1 | 4        | -1
+        b | 7 | 4        | 1
+        """
+    )
+    r = t.groupby(pw.this.g).reduce(pw.this.g, n=pw.reducers.count())
+    assert_rows(r, [("b", 1)])
+
+
+def test_stateful_single():
+    def accumulate(state, value):
+        return (state or 0) + value
+
+    reducer = pw.reducers.stateful_single(accumulate)
+    r = vals().groupby(pw.this.g).reduce(pw.this.g, s=reducer(pw.this.v))
+    assert_rows(r, [("a", 6), ("b", 5)])
+
+
+def test_udf_reducer():
+    class StdDevAcc(pw.BaseCustomAccumulator):
+        def __init__(self, cnt, s, s2):
+            self.cnt, self.s, self.s2 = cnt, s, s2
+
+        @classmethod
+        def from_row(cls, row):
+            (v,) = row
+            return cls(1, v, v * v)
+
+        def update(self, other):
+            self.cnt += other.cnt
+            self.s += other.s
+            self.s2 += other.s2
+
+        def retract(self, other):
+            self.cnt -= other.cnt
+            self.s -= other.s
+            self.s2 -= other.s2
+
+        def compute_result(self) -> float:
+            mean = self.s / self.cnt
+            return self.s2 / self.cnt - mean * mean
+
+    stddev = pw.reducers.udf_reducer(StdDevAcc)
+    t = pw.debug.table_from_markdown(
+        """
+        g | v
+        a | 2
+        a | 4
+        """
+    )
+    r = t.groupby(pw.this.g).reduce(pw.this.g, var=stddev(pw.this.v))
+    rows = list(rows_of(r))
+    assert rows[0][1] == pytest.approx(1.0)
+
+
+def test_expression_over_reducers():
+    r = vals().groupby(pw.this.g).reduce(
+        pw.this.g,
+        spread=pw.reducers.max(pw.this.v) - pw.reducers.min(pw.this.v),
+    )
+    assert_rows(r, [("a", 2), ("b", 0)])
+
+
+def test_global_reduce():
+    r = vals().reduce(n=pw.reducers.count(), s=pw.reducers.sum(pw.this.v))
+    assert_rows(r, [(4, 11)])

@@ -1,0 +1,208 @@
+"""Table operation coverage (reference: python/pathway/tests/test_common.py core
+Table ops)."""
+
+import pytest
+
+import pathway_tpu as pw
+from tests.utils import assert_rows, assert_table_equality_wo_index, keyed_rows_of, rows_of
+
+
+def people():
+    return pw.debug.table_from_markdown(
+        """
+        name  | age | city
+        Alice | 30  | NYC
+        Bob   | 25  | SF
+        Carol | 35  | NYC
+        """
+    )
+
+
+def test_select_and_rename():
+    t = people().select(pw.this.name, years=pw.this.age)
+    assert_rows(t, [("Alice", 30), ("Bob", 25), ("Carol", 35)])
+    r = t.rename(handle=pw.this.name)
+    assert set(r.column_names()) == {"handle", "years"}
+
+
+def test_star_select():
+    t = people().select(*pw.this)
+    assert set(t.column_names()) == {"name", "age", "city"}
+
+
+def test_with_columns_without():
+    t = people().with_columns(next_age=pw.this.age + 1).without("city")
+    assert_rows(t, [("Alice", 30, 31), ("Bob", 25, 26), ("Carol", 35, 36)])
+
+
+def test_filter_keeps_keys():
+    t = people()
+    f = t.filter(pw.this.age > 26)
+    orig = keyed_rows_of(t)
+    kept = keyed_rows_of(f)
+    assert set(kept).issubset(set(orig))
+    assert len(kept) == 2
+
+
+def test_split():
+    old, young = people().split(pw.this.age >= 30)
+    assert len(rows_of(old)) == 2
+    assert len(rows_of(young)) == 1
+
+
+def test_concat_and_reindex():
+    a = people().filter(pw.this.age > 26)
+    b = people().filter(pw.this.age <= 26)
+    u = a.concat(b)
+    assert_table_equality_wo_index(u, people())
+    d = a.concat_reindex(a)
+    assert sum(rows_of(d).values()) == 4  # duplicated rows, distinct ids
+
+
+def test_update_rows():
+    base = people()
+    updates = pw.debug.table_from_markdown(
+        """
+        name  | age | city
+        Alice | 31  | NYC
+        Zed   | 99  | LA
+        """
+    ).with_id_from(pw.this.name)
+    merged = base.with_id_from(pw.this.name).update_rows(updates)
+    got = rows_of(merged)
+    assert got[("Alice", 31, "NYC")] == 1
+    assert got[("Zed", 99, "LA")] == 1
+    assert got[("Bob", 25, "SF")] == 1
+    assert sum(got.values()) == 4
+
+
+def test_update_cells():
+    base = people().with_id_from(pw.this.name)
+    patch = (
+        pw.debug.table_from_markdown(
+            """
+            name  | age
+            Alice | 99
+            """
+        )
+        .with_id_from(pw.this.name)
+        .select(age=pw.this.age)
+    )
+    merged = base.update_cells(patch.promise_universe_is_subset_of(base))
+    got = rows_of(merged)
+    assert got[("Alice", 99, "NYC")] == 1
+    assert got[("Bob", 25, "SF")] == 1
+
+
+def test_difference_intersect_restrict():
+    t = people()
+    old = t.filter(pw.this.age >= 30)
+    assert len(rows_of(t.difference(old))) == 1
+    assert len(rows_of(t.intersect(old))) == 2
+    assert len(rows_of(t.restrict(old, strict=False))) == 2
+
+
+def test_with_id_from_stable():
+    t = people().with_id_from(pw.this.name)
+    t2 = people().with_id_from(pw.this.name)
+    assert keyed_rows_of(t) == keyed_rows_of(t2)
+
+
+def test_flatten():
+    t = pw.debug.table_from_markdown(
+        """
+        k | csv
+        a | '1,2,3'
+        b | '4'
+        """
+    ).select(pw.this.k, parts=pw.this.csv.str.split(","))
+    f = t.flatten(pw.this.parts)
+    assert_rows(
+        f.select(pw.this.parts, pw.this.k),
+        [("1", "a"), ("2", "a"), ("3", "a"), ("4", "b")],
+    )
+
+
+def test_flatten_origin_id():
+    t = pw.debug.table_from_markdown(
+        """
+        parts
+        '1,2'
+        """
+    ).select(parts=pw.this.parts.str.split(","))
+    f = t.flatten(pw.this.parts, origin_id="origin")
+    rows = list(rows_of(f))
+    assert len(rows) == 2
+    oi = f.column_names().index("origin")
+    assert len({r[oi] for r in rows}) == 1
+
+
+def test_ix():
+    target = people().with_id_from(pw.this.name)
+    src = pw.debug.table_from_markdown(
+        """
+        who
+        Alice
+        Carol
+        """
+    )
+    withptr = src.select(pw.this.who, p=target.pointer_from(pw.this.who))
+    got = target.ix(withptr.p)
+    assert_rows(got.select(pw.this.age), [(30,), (35,)])
+
+
+def test_ix_ref():
+    target = people().with_id_from(pw.this.name)
+    src = pw.debug.table_from_markdown(
+        """
+        who
+        Alice
+        Bob
+        """
+    )
+    got = target.ix_ref(src.who, context=src)
+    assert_rows(got.select(pw.this.city), [("NYC",), ("SF",)])
+
+
+def test_having():
+    target = people().with_id_from(pw.this.name)
+    src = pw.debug.table_from_markdown(
+        """
+        who
+        Alice
+        Nobody
+        """
+    )
+    withptr = src.select(p=target.pointer_from(pw.this.who))
+    kept = target.having(withptr.p)
+    assert len(rows_of(kept)) == 1
+
+
+def test_multi_table_select_same_universe():
+    t = people()
+    doubled = t.select(a2=pw.this.age * 2)
+    combined = t.select(pw.this.name, x=doubled.a2)
+    assert_rows(combined, [("Alice", 60), ("Bob", 50), ("Carol", 70)])
+
+
+def test_cast_to_types():
+    t = people().cast_to_types(age=float)
+    from pathway_tpu.internals import dtype as dt
+
+    assert t.schema.dtypes()["age"] == dt.FLOAT
+
+
+def test_groupby_with_custom_id():
+    t = people()
+    r = t.groupby(pw.this.city, id=t.pointer_from(pw.this.city)).reduce(
+        pw.this.city, n=pw.reducers.count()
+    )
+    keyed = keyed_rows_of(r)
+    expect_key = int(__import__("pathway_tpu.internals.keys", fromlist=["ref_scalar"]).ref_scalar("NYC"))
+    assert expect_key in keyed
+
+
+def test_empty_table():
+    t = pw.Table.empty(x=int)
+    assert rows_of(t) == {}
+    assert len(rows_of(t)) == 0

@@ -1,0 +1,62 @@
+"""Test helpers — the diff-assert toolkit (role of the reference's
+``python/pathway/tests/utils.py``: assert_table_equality, stream assertions)."""
+
+from __future__ import annotations
+
+from collections import Counter
+from typing import Any
+
+import numpy as np
+
+import pathway_tpu as pw
+from pathway_tpu.debug import _capture
+
+
+def _norm(v: Any) -> Any:
+    if isinstance(v, np.generic):
+        return v.item()
+    if isinstance(v, tuple):
+        return tuple(_norm(x) for x in v)
+    if isinstance(v, np.ndarray):
+        return ("ndarray", v.shape, tuple(np.asarray(v).ravel().tolist()))
+    return v
+
+
+def rows_of(table: pw.Table) -> Counter:
+    """Final rows as a multiset of value tuples (ids ignored)."""
+    cap = _capture(table)
+    return Counter(tuple(_norm(v) for v in row) for row in cap.rows.values())
+
+
+def keyed_rows_of(table: pw.Table) -> dict[int, tuple]:
+    cap = _capture(table)
+    return {k: tuple(_norm(v) for v in row) for k, row in cap.rows.items()}
+
+
+def deltas_of(table: pw.Table) -> list[tuple[int, int, int, tuple]]:
+    cap = _capture(table)
+    return [(t, k, d, tuple(_norm(v) for v in row)) for (t, k, d, row) in cap.deltas]
+
+
+def assert_table_equality_wo_index(actual: pw.Table, expected: pw.Table) -> None:
+    a, e = rows_of(actual), rows_of(expected)
+    assert a == e, f"tables differ:\n actual={sorted(a.items())}\n expected={sorted(e.items())}"
+
+
+def assert_table_equality(actual: pw.Table, expected: pw.Table) -> None:
+    a, e = keyed_rows_of(actual), keyed_rows_of(expected)
+    assert a == e, f"tables differ (keyed):\n actual={a}\n expected={e}"
+
+
+def assert_rows(table: pw.Table, expected: list[tuple]) -> None:
+    a = rows_of(table)
+    e = Counter(tuple(_norm(v) for v in row) for row in expected)
+    assert a == e, f"tables differ:\n actual={sorted(a.items())}\n expected={sorted(e.items())}"
+
+
+def assert_stream_consistent(table: pw.Table) -> None:
+    """Every retraction must retract a previously-inserted identical row."""
+    state: Counter = Counter()
+    for t, k, d, row in deltas_of(table):
+        state[(k, row)] += d
+        assert state[(k, row)] >= 0, f"retraction without insertion at time {t}: {row}"
