@@ -1,0 +1,25 @@
+"""TPU compute ops — the JAX/XLA hot path of the framework.
+
+Where the reference delegates ML compute to external libraries called per row
+(``src/external_integration/brute_force_knn_integration.rs``: ndarray dot + k_smallest;
+``xpacks/llm/embedders.py:385-398``: torch ``model.encode`` per row), this package owns
+the compute natively, designed MXU-first:
+
+- :mod:`pathway_tpu.ops.knn` — HBM-resident brute-force KNN (einsum + top_k),
+  single-chip and ``shard_map``-sharded over a device mesh.
+- :mod:`pathway_tpu.ops.encoder` — a pure-JAX transformer sentence encoder (the
+  flagship model) with tensor/data-parallel sharding rules.
+- :mod:`pathway_tpu.ops.reranker` — cross-encoder scoring on TPU.
+- :mod:`pathway_tpu.ops.microbatch` — accumulate-then-launch UDF dispatcher:
+  rows buffered, padded to power-of-two buckets, one jitted call per bucket.
+"""
+
+from pathway_tpu.ops.knn import BruteForceKnnIndex, KnnMetric
+from pathway_tpu.ops.microbatch import MicrobatchDispatcher, bucket_size
+
+__all__ = [
+    "BruteForceKnnIndex",
+    "KnnMetric",
+    "MicrobatchDispatcher",
+    "bucket_size",
+]

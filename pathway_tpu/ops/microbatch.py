@@ -1,0 +1,102 @@
+"""Accumulate-then-launch UDF microbatcher.
+
+The reference dispatches one boxed future **per row** for async UDFs
+(``src/engine/dataflow.rs:1924-1962``). That per-row pattern is the single worst fit
+for XLA (SURVEY §3.4, §7.1.5): each jit call has fixed dispatch overhead and a fresh
+compile per shape. This dispatcher instead:
+
+1. buffers rows per (udf, logical time window),
+2. pads each flush to the next power-of-two *bucket* (so the jitted callee sees a
+   small closed set of shapes → compile cache hits),
+3. invokes the batch function once per bucket,
+4. un-pads and scatters results back in row order.
+
+Works for any callee that maps ``list[values] -> list[results]``; TPU model UDFs
+(embedder/reranker) provide a ``batch_fn`` operating on the padded arrays directly.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Sequence
+
+import numpy as np
+
+_MIN_BUCKET = 8
+
+
+def bucket_size(n: int, min_bucket: int = _MIN_BUCKET, max_bucket: int = 4096) -> int:
+    """Smallest power-of-two ≥ n (clamped) — the padded batch shape."""
+    b = min_bucket
+    while b < n and b < max_bucket:
+        b *= 2
+    return b
+
+
+class MicrobatchDispatcher:
+    """Buffer rows, flush in padded power-of-two batches.
+
+    ``fn`` is called as ``fn(items: list) -> Sequence`` where ``len(items)`` is
+    always a bucket size; entries beyond the real row count are ``pad_item``
+    repeats whose results are discarded.
+    """
+
+    def __init__(
+        self,
+        fn: Callable[[list], Sequence],
+        max_batch: int = 1024,
+        min_bucket: int = _MIN_BUCKET,
+        pad_item: Any = None,
+    ):
+        self.fn = fn
+        self.max_batch = max_batch
+        self.min_bucket = min_bucket
+        self.pad_item = pad_item
+        self._items: list = []
+
+    def __len__(self) -> int:
+        return len(self._items)
+
+    def submit(self, item: Any) -> None:
+        self._items.append(item)
+
+    def flush(self) -> list:
+        """Run the batch fn over everything buffered; returns results in submit
+        order."""
+        out: list = []
+        while self._items:
+            chunk = self._items[: self.max_batch]
+            del self._items[: self.max_batch]
+            n = len(chunk)
+            b = bucket_size(n, self.min_bucket, self.max_batch)
+            pad = chunk[-1] if self.pad_item is None else self.pad_item
+            padded = chunk + [pad] * (b - n)
+            results = self.fn(padded)
+            if len(results) != b:
+                raise ValueError(
+                    f"microbatch fn returned {len(results)} results for batch of {b}"
+                )
+            out.extend(results[:n])
+        return out
+
+    def map(self, items: list) -> list:
+        """One-shot convenience: submit all, flush."""
+        for it in items:
+            self.submit(it)
+        return self.flush()
+
+
+def pad_ragged_2d(
+    rows: list[np.ndarray], bucket_len: int | None = None, fill: float = 0
+) -> tuple[np.ndarray, np.ndarray]:
+    """Pad a list of 1-D arrays to [n, L] + bool mask, L a power-of-two bucket —
+    the shape discipline for token-id batches entering jitted models."""
+    n = len(rows)
+    max_len = max((len(r) for r in rows), default=1)
+    L = bucket_len or bucket_size(max_len, min_bucket=16)
+    out = np.full((n, L), fill, dtype=np.asarray(rows[0]).dtype if rows else np.int32)
+    mask = np.zeros((n, L), dtype=bool)
+    for i, r in enumerate(rows):
+        r = np.asarray(r)[:L]
+        out[i, : len(r)] = r
+        mask[i, : len(r)] = True
+    return out, mask
