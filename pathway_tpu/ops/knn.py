@@ -159,12 +159,7 @@ class BruteForceKnnIndex:
         self._free.extend(range(new - 1, old - 1, -1))
 
     # -- mutation ------------------------------------------------------------
-    def add(self, key: Any, vector: np.ndarray | Sequence[float]) -> None:
-        vec = np.asarray(vector, dtype=np.float32)
-        if vec.shape != (self.dimension,):
-            raise ValueError(
-                f"vector shape {vec.shape} != ({self.dimension},) for key {key!r}"
-            )
+    def _stage(self, key: Any, vec: np.ndarray) -> None:
         if key in self._key_to_slot:
             slot = self._key_to_slot[key]  # upsert in place
         else:
@@ -177,6 +172,25 @@ class BruteForceKnnIndex:
         self._pending_slots.append(slot)
         self._pending_rows.append(vec)
 
+    def add(self, key: Any, vector: np.ndarray | Sequence[float]) -> None:
+        vec = np.asarray(vector, dtype=np.float32)
+        if vec.shape != (self.dimension,):
+            raise ValueError(
+                f"vector shape {vec.shape} != ({self.dimension},) for key {key!r}"
+            )
+        self._stage(key, vec)
+
+    def add_batch(self, keys: Sequence[Any], vectors: np.ndarray) -> None:
+        """Bulk add/upsert: one host loop over bookkeeping, vectors staged as rows
+        of a single [m, d] array (skips per-row asarray/validate overhead)."""
+        vecs = np.asarray(vectors, dtype=np.float32)
+        if vecs.shape != (len(keys), self.dimension):
+            raise ValueError(
+                f"vectors shape {vecs.shape} != ({len(keys)}, {self.dimension})"
+            )
+        for key, vec in zip(keys, vecs):
+            self._stage(key, vec)
+
     def remove(self, key: Any) -> None:
         slot = self._key_to_slot.pop(key, None)
         if slot is None:
@@ -187,7 +201,16 @@ class BruteForceKnnIndex:
 
     def _flush(self) -> None:
         if self._pending_slots:
-            slots = jnp.asarray(self._pending_slots, dtype=jnp.int32)
+            # the same slot can be staged twice (upsert within one flush window);
+            # jnp scatter with duplicate indices has an undefined winner, so keep
+            # only the last staging per slot before dispatch
+            slot_arr = np.asarray(self._pending_slots, dtype=np.int32)
+            if len(np.unique(slot_arr)) != len(slot_arr):
+                last = {int(s): i for i, s in enumerate(slot_arr)}
+                keep = sorted(last.values())
+                slot_arr = slot_arr[keep]
+                self._pending_rows = [self._pending_rows[i] for i in keep]
+            slots = jnp.asarray(slot_arr)
             stacked = np.stack(self._pending_rows).astype(np.float32)
             self._vectors = _update_slots(
                 self._vectors, slots, jnp.asarray(stacked, dtype=self.dtype)

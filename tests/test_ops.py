@@ -70,6 +70,24 @@ def test_knn_remove_and_upsert():
         index.remove("zzz")
 
 
+def test_knn_add_batch():
+    rng = np.random.default_rng(3)
+    a = BruteForceKnnIndex(dimension=8, metric="dot")
+    b = BruteForceKnnIndex(dimension=8, metric="dot")
+    vecs = rng.normal(size=(300, 8)).astype(np.float32)  # > capacity → mid-batch grow
+    for i, v in enumerate(vecs):
+        a.add(i, v)
+    b.add_batch(list(range(300)), vecs)
+    q = rng.normal(size=8).astype(np.float32)
+    assert a.search(q, 10) == b.search(q, 10)
+    # upsert via batch: duplicate key within one batch — last write wins
+    b.add_batch(["x", "x"], np.stack([np.ones(8), np.full(8, 5.0)]).astype(np.float32))
+    hits = b.search(np.ones(8, np.float32), 1)[0]
+    assert hits[0][0] == "x" and hits[0][1] == pytest.approx(40.0)
+    with pytest.raises(ValueError):
+        b.add_batch([1, 2], np.zeros((3, 8), np.float32))
+
+
 def test_knn_growth_past_capacity():
     rng = np.random.default_rng(1)
     index = BruteForceKnnIndex(dimension=8, capacity=128)
