@@ -69,6 +69,10 @@ def table_from_markdown(
     header = rows_tok[0]
     body = rows_tok[1:]
     columns = [h for h in header]
+    # reference style: empty leading header cell = id column ("  | a | b" over
+    # rows "1 | x | y"); detect by body rows carrying one extra token
+    if body and all(len(r) == len(columns) + 1 for r in body) and "id" not in columns:
+        columns = ["id"] + columns
     parsed = [[_parse_value(t) for t in row] for row in body]
 
     id_idx = columns.index("id") if "id" in columns else None
@@ -102,7 +106,14 @@ def table_from_markdown(
     rows = [tuple(row[columns.index(c)] for c in data_cols) for row in parsed]
     # keys
     if id_idx is not None:
-        keys = [int(np.uint64(row[id_idx])) for row in parsed]
+        from pathway_tpu.internals.keys import stable_hash_obj
+
+        def label_key(v: Any) -> int:
+            if isinstance(v, (int, np.integer)) and 0 <= int(v) < 2**64:
+                return int(np.uint64(v))
+            return int(stable_hash_obj(v))
+
+        keys = [label_key(row[id_idx]) for row in parsed]
     elif id_from:
         cols_for_id = []
         for c in id_from:

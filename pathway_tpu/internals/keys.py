@@ -77,6 +77,20 @@ def _canonical_bytes(v: Any) -> bytes:
 
 
 def stable_hash_obj(v: Any) -> np.uint64:
+    # Scalars that can also live in typed numpy columns MUST hash identically to
+    # hash_column's vectorized paths — join/group keys may see the same value in
+    # either storage (e.g. int64 column on one side, object column on the other).
+    if isinstance(v, (bool, np.bool_, int, np.integer)):
+        return splitmix64(np.asarray([int(v) & 0xFFFFFFFFFFFFFFFF], dtype=np.uint64))[0]
+    if isinstance(v, (float, np.floating)):
+        f = np.float64(v) + 0.0  # normalize -0.0
+        return splitmix64(f.view(np.uint64).reshape(1))[0]
+    if isinstance(v, np.datetime64):
+        ns = v.astype("datetime64[ns]").astype(np.int64)
+        return splitmix64(np.asarray([ns], dtype=np.uint64))[0]
+    if isinstance(v, np.timedelta64):
+        ns = v.astype("timedelta64[ns]").astype(np.int64)
+        return splitmix64(np.asarray([ns], dtype=np.uint64))[0]
     digest = hashlib.blake2b(_canonical_bytes(v), digest_size=8).digest()
     return np.uint64(int.from_bytes(digest, "little"))
 
@@ -93,8 +107,12 @@ def hash_column(col: np.ndarray) -> np.ndarray:
         # normalize -0.0 → 0.0 so equal floats hash equal
         c = col + 0.0
         return splitmix64(c.view(np.uint64) if c.dtype == np.float64 else c.astype(np.float64).view(np.uint64))
-    if kind in ("M", "m"):
-        return splitmix64(col.astype(np.int64).astype(np.uint64))
+    if kind == "M":
+        # normalize to ns so equal instants in different units hash equal (and
+        # match stable_hash_obj / _canonical_bytes)
+        return splitmix64(col.astype("datetime64[ns]").astype(np.int64).astype(np.uint64))
+    if kind == "m":
+        return splitmix64(col.astype("timedelta64[ns]").astype(np.int64).astype(np.uint64))
     return _hash_obj_ufunc(col).astype(np.uint64)
 
 

@@ -1,0 +1,123 @@
+"""Sorted prev/next neighbor maintenance (``Table.sort``).
+
+Counterpart of the reference's ``prev_next.rs`` timely operator (built on its patched
+bidirectional differential cursors, SURVEY §2.9): for every row, emit pointers to the
+previous/next row in ``key`` order within its ``instance`` partition. Output universe
+equals the input universe; columns are ``prev``/``next`` Optional[Pointer].
+
+Incrementality: the node keeps each instance's order as a sorted list and the
+previously-emitted (prev, next) per key; on change it re-derives the neighborhood and
+emits only the delta (retract old pair, insert new pair) — per-row granularity like
+the reference, though recomputation is per-instance O(n log n) rather than cursor-
+local (acceptable: sort feeds asof joins and ``Table.diff`` where instances are
+small; revisit with a skip-list if profiles say otherwise).
+"""
+
+from __future__ import annotations
+
+import bisect
+from typing import Any, Callable
+
+import numpy as np
+
+from pathway_tpu.engine.blocks import DeltaBatch
+from pathway_tpu.engine.graph import Node
+from pathway_tpu.internals import dtype as dt
+from pathway_tpu.internals.logical import LogicalNode
+
+
+class SortNode(Node):
+    name = "sort"
+
+    def __init__(
+        self,
+        key_fn: Callable[[DeltaBatch], np.ndarray],
+        instance_fn: Callable[[DeltaBatch], np.ndarray] | None,
+    ):
+        super().__init__(n_inputs=1)
+        self.key_fn = key_fn
+        self.instance_fn = instance_fn
+        # row key -> (instance, sort_key); instance -> sorted [(sort_key, row_key)]
+        self._row_info: dict[int, tuple[Any, Any]] = {}
+        self._orders: dict[Any, list[tuple[Any, int]]] = {}
+        # row key -> (prev, next) currently emitted
+        self._emitted: dict[int, tuple[int | None, int | None]] = {}
+
+    def process(self, inputs, time):
+        batch = inputs[0]
+        if batch is None:
+            return []
+        sort_keys = self.key_fn(batch)
+        instances = (
+            self.instance_fn(batch)
+            if self.instance_fn is not None
+            else np.zeros(len(batch), dtype=np.int64)
+        )
+        touched_instances: set = set()
+        for i in range(len(batch)):
+            key = int(batch.keys[i])
+            if batch.diffs[i] > 0:
+                info = (instances[i], sort_keys[i])
+                self._row_info[key] = info
+                order = self._orders.setdefault(info[0], [])
+                bisect.insort(order, (info[1], key))
+                touched_instances.add(info[0])
+            else:
+                info = self._row_info.pop(key, None)
+                if info is None:
+                    continue
+                order = self._orders.get(info[0], [])
+                pos = bisect.bisect_left(order, (info[1], key))
+                if pos < len(order) and order[pos] == (info[1], key):
+                    order.pop(pos)
+                touched_instances.add(info[0])
+
+        # re-derive neighborhoods for touched instances, emit deltas
+        out_keys: list[int] = []
+        out_diffs: list[int] = []
+        out_rows: list[tuple] = []
+
+        def emit(key: int, pair: tuple, diff: int) -> None:
+            out_keys.append(key)
+            out_diffs.append(diff)
+            out_rows.append(pair)
+
+        for inst in touched_instances:
+            order = self._orders.get(inst, [])
+            for pos, (_, key) in enumerate(order):
+                prev_key = order[pos - 1][1] if pos > 0 else None
+                next_key = order[pos + 1][1] if pos + 1 < len(order) else None
+                pair = (prev_key, next_key)
+                old = self._emitted.get(key)
+                if old == pair:
+                    continue
+                if old is not None:
+                    emit(key, old, -1)
+                emit(key, pair, +1)
+                self._emitted[key] = pair
+        # rows deleted from the order need their last emission retracted
+        for i in range(len(batch)):
+            key = int(batch.keys[i])
+            if batch.diffs[i] < 0 and key not in self._row_info:
+                old = self._emitted.pop(key, None)
+                if old is not None:
+                    emit(key, old, -1)
+        if not out_keys:
+            return []
+        return [
+            DeltaBatch.from_rows(out_keys, out_rows, ["prev", "next"], time, diffs=out_diffs)
+        ]
+
+
+def sort_impl(table, key_expr, instance_expr=None):
+    from pathway_tpu.internals import schema as schema_mod
+    from pathway_tpu.internals.table import Table, _compile_single
+
+    key_fn = _compile_single(key_expr, table)
+    inst_fn = _compile_single(instance_expr, table) if instance_expr is not None else None
+    node = LogicalNode(lambda: SortNode(key_fn, inst_fn), [table._node], name="sort")
+    schema = schema_mod.schema_from_dtypes(
+        {"prev": dt.Optional(dt.Pointer()), "next": dt.Optional(dt.Pointer())}
+    )
+    # same universe: every input row gets exactly one (prev, next) row
+    return Table(node, schema, table._universe)

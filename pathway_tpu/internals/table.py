@@ -71,7 +71,9 @@ class Table:
         return self._schema.typehints()
 
     def __getattr__(self, name: str) -> ColumnReference:
-        if name.startswith("_"):
+        # allow temporal marker columns (_pw_window etc.) through; other
+        # underscore names are internal attributes
+        if name.startswith("_") and not name.startswith("_pw_"):
             raise AttributeError(name)
         if name not in self._schema.column_names():
             raise AttributeError(

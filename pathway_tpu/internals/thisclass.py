@@ -31,7 +31,11 @@ class ThisPlaceholder:
     def __getitem__(self, name: str) -> ColumnReference:
         if isinstance(name, ColumnReference):
             name = name.name
-        return self.__getattr__(name)
+        # explicit bracket access allows any column name, including dunder
+        # internals that attribute access rejects
+        ref = ColumnReference(None, name)
+        ref._placeholder_side = self._side  # type: ignore[attr-defined]
+        return ref
 
     @property
     def id(self) -> ColumnReference:

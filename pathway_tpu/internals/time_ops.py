@@ -1,0 +1,289 @@
+"""Temporal behavior primitives: buffer, forget, freeze (+ forget_immediately).
+
+Block-engine counterparts of the reference's custom timely operators in
+``src/engine/dataflow/operators/time_column.rs`` (driven from
+``internals/table.py:670-754``): each tracks a **watermark** — the max value of the
+``current_time`` column over all rows seen — and compares it to each row's
+``threshold`` column when the frontier advances:
+
+- **buffer**: rows with ``threshold > watermark`` are held back (consolidated in the
+  buffer) and released once the watermark passes their threshold. Rows already past
+  threshold flow through immediately.
+- **forget**: rows are passed through, then retracted once the watermark passes
+  their threshold; late rows (arriving already past threshold) are dropped.
+- **freeze**: once the watermark passes a row's threshold the row is immutable —
+  subsequent updates/retractions for it are dropped, as are late arrivals.
+- **forget_immediately**: every row is retracted at the end of its own tick
+  (serves the as-of-now request/response pattern, reference
+  ``internals/table.py`` ``_forget_immediately``).
+
+Watermark updates follow the reference's discipline (temporal_behavior.py docstring):
+the recorded time advances only after the whole input batch of a tick is processed,
+so simultaneous arrivals all see the pre-tick watermark.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import numpy as np
+
+from pathway_tpu.engine.blocks import DeltaBatch, consolidate
+from pathway_tpu.engine.graph import END_OF_STREAM, Node
+from pathway_tpu.internals.logical import LogicalNode
+
+
+class _WatermarkNode(Node):
+    """Shared machinery: evaluate threshold/current-time per row, keep watermark.
+
+    The watermark starts as ``None`` (no data seen) rather than ``-inf`` so time
+    columns of any comparable dtype (ints, floats, datetime64) work."""
+
+    def __init__(
+        self,
+        threshold_fn: Callable[[DeltaBatch], np.ndarray],
+        current_time_fn: Callable[[DeltaBatch], np.ndarray],
+    ):
+        super().__init__(n_inputs=1)
+        self.threshold_fn = threshold_fn
+        self.current_time_fn = current_time_fn
+        self.watermark: Any = None
+        self._tick_max: Any = None
+
+    def _observe(self, batch: DeltaBatch) -> np.ndarray:
+        """Track the batch's max current-time (applied to the watermark at frontier);
+        return per-row thresholds."""
+        cur = self.current_time_fn(batch)
+        if len(cur):
+            m = np.max(cur)
+            if self._tick_max is None or m > self._tick_max:
+                self._tick_max = m
+        return self.threshold_fn(batch)
+
+    def _past(self, threshold: Any) -> bool:
+        """Has the watermark passed this threshold?"""
+        return self.watermark is not None and threshold <= self.watermark
+
+    def _advance_watermark(self) -> None:
+        if self._tick_max is not None and (
+            self.watermark is None or self._tick_max > self.watermark
+        ):
+            self.watermark = self._tick_max
+
+
+class BufferNode(_WatermarkNode):
+    name = "buffer"
+
+    def __init__(self, threshold_fn, current_time_fn):
+        super().__init__(threshold_fn, current_time_fn)
+        # key -> [threshold, values, net_diff]
+        self._held: dict[int, list] = {}
+
+    def process(self, inputs, time):
+        batch = inputs[0]
+        if batch is None:
+            return []
+        thresholds = self._observe(batch)
+        pass_idx: list[int] = []
+        cols = list(batch.data.values())
+        for i in range(len(batch)):
+            thr = thresholds[i]
+            if self._past(thr):
+                pass_idx.append(i)
+                continue
+            key = int(batch.keys[i])
+            entry = self._held.get(key)
+            row = tuple(c[i] for c in cols)
+            if entry is None:
+                self._held[key] = [thr, row, int(batch.diffs[i])]
+            else:
+                entry[0] = thr
+                entry[2] += int(batch.diffs[i])
+                if batch.diffs[i] > 0:
+                    entry[1] = row
+                if entry[2] == 0:
+                    del self._held[key]
+        if not pass_idx:
+            return []
+        return [batch.take(np.asarray(pass_idx, dtype=np.int64))]
+
+    def _release(self, time: int) -> list[DeltaBatch]:
+        if time == END_OF_STREAM:
+            due = list(self._held.items())  # close: flush everything (reference
+            # flushes buffers when input ends so no data is lost)
+        else:
+            due = [(k, e) for k, e in self._held.items() if self._past(e[0])]
+        if not due:
+            return []
+        for k, _ in due:
+            del self._held[k]
+        keys = [k for k, _ in due]
+        rows = [e[1] for _, e in due]
+        diffs = [e[2] for _, e in due]
+        columns = list(self._columns)
+        return [
+            consolidate(
+                DeltaBatch.from_rows(keys, rows, columns, time, diffs=diffs)
+            )
+        ]
+
+    def on_frontier(self, time):
+        self._advance_watermark()
+        if not self._held:
+            return []
+        # column names aren't known until the first batch arrives
+        if not hasattr(self, "_columns"):
+            return []
+        return self._release(time)
+
+    def accept(self, port, batch):
+        if not hasattr(self, "_columns"):
+            self._columns = list(batch.data.keys())
+        super().accept(port, batch)
+
+
+class ForgetNode(_WatermarkNode):
+    name = "forget"
+
+    def __init__(self, threshold_fn, current_time_fn, mark_forgetting_records=False):
+        super().__init__(threshold_fn, current_time_fn)
+        self.mark = mark_forgetting_records
+        # key -> [threshold, values, net_diff] of rows currently downstream
+        self._live: dict[int, list] = {}
+        self._columns: list[str] | None = None
+
+    def process(self, inputs, time):
+        batch = inputs[0]
+        if batch is None:
+            return []
+        if self._columns is None:
+            self._columns = list(batch.data.keys())
+        thresholds = self._observe(batch)
+        keep_idx: list[int] = []
+        cols = list(batch.data.values())
+        for i in range(len(batch)):
+            if self._past(thresholds[i]):
+                continue  # late: already forgotten territory
+            keep_idx.append(i)
+            key = int(batch.keys[i])
+            entry = self._live.get(key)
+            row = tuple(c[i] for c in cols)
+            if entry is None:
+                self._live[key] = [thresholds[i], row, int(batch.diffs[i])]
+            else:
+                entry[0] = thresholds[i]
+                entry[2] += int(batch.diffs[i])
+                if batch.diffs[i] > 0:
+                    entry[1] = row
+                if entry[2] == 0:
+                    del self._live[key]
+        if not keep_idx:
+            return []
+        return [batch.take(np.asarray(keep_idx, dtype=np.int64))]
+
+    def on_frontier(self, time):
+        self._advance_watermark()
+        if self._columns is None or time == END_OF_STREAM:
+            return []  # closing the stream does NOT forget remaining rows
+        due = [(k, e) for k, e in self._live.items() if self._past(e[0])]
+        if not due:
+            return []
+        for k, _ in due:
+            del self._live[k]
+        keys = [k for k, _ in due]
+        rows = [e[1] for _, e in due]
+        diffs = [-e[2] for _, e in due]
+        return [DeltaBatch.from_rows(keys, rows, self._columns, time, diffs=diffs)]
+
+
+class FreezeNode(_WatermarkNode):
+    name = "freeze"
+
+    def __init__(self, threshold_fn, current_time_fn):
+        super().__init__(threshold_fn, current_time_fn)
+        self._frozen: set[int] = set()
+        # key -> threshold of rows passed but not yet frozen
+        self._pending_freeze: dict[int, Any] = {}
+
+    def process(self, inputs, time):
+        batch = inputs[0]
+        if batch is None:
+            return []
+        thresholds = self._observe(batch)
+        keep_idx: list[int] = []
+        for i in range(len(batch)):
+            key = int(batch.keys[i])
+            if key in self._frozen or self._past(thresholds[i]):
+                continue  # frozen row or late arrival: drop the update
+            keep_idx.append(i)
+            self._pending_freeze[key] = thresholds[i]
+        if not keep_idx:
+            return []
+        return [batch.take(np.asarray(keep_idx, dtype=np.int64))]
+
+    def on_frontier(self, time):
+        self._advance_watermark()
+        newly = [k for k, thr in self._pending_freeze.items() if self._past(thr)]
+        for k in newly:
+            self._frozen.add(k)
+            del self._pending_freeze[k]
+        return []
+
+
+class ForgetImmediatelyNode(Node):
+    name = "forget_immediately"
+
+    def __init__(self):
+        super().__init__(n_inputs=1)
+        self._this_tick: list[DeltaBatch] = []
+
+    def process(self, inputs, time):
+        batch = inputs[0]
+        if batch is None:
+            return []
+        self._this_tick.append(batch)
+        return [batch]
+
+    def on_frontier(self, time):
+        out = [b.negated() for b in self._this_tick]
+        self._this_tick = []
+        return out
+
+
+# ---------------------------------------------------------------- table-level impls
+
+
+def _impl(table, threshold_column, current_time_column, node_cls, **kw):
+    from pathway_tpu.internals.table import Table, _compile_single
+
+    thr_fn = _compile_single(table._bind(threshold_column), table)
+    cur_fn = _compile_single(table._bind(current_time_column), table)
+    node = LogicalNode(
+        lambda: node_cls(thr_fn, cur_fn, **kw), [table._node], name=node_cls.name
+    )
+    return Table(node, table._schema, table._universe.subset())
+
+
+def buffer_impl(table, threshold_column, current_time_column):
+    return _impl(table, threshold_column, current_time_column, BufferNode)
+
+
+def forget_impl(table, threshold_column, current_time_column, mark_forgetting_records=False):
+    return _impl(
+        table,
+        threshold_column,
+        current_time_column,
+        ForgetNode,
+        mark_forgetting_records=mark_forgetting_records,
+    )
+
+
+def freeze_impl(table, threshold_column, current_time_column):
+    return _impl(table, threshold_column, current_time_column, FreezeNode)
+
+
+def forget_immediately_impl(table):
+    from pathway_tpu.internals.table import Table
+
+    node = LogicalNode(ForgetImmediatelyNode, [table._node], name="forget_immediately")
+    return Table(node, table._schema, table._universe.subset())

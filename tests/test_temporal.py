@@ -1,0 +1,427 @@
+"""Temporal layer tests: windows, behaviors, interval/asof/asof-now/window joins,
+sort/diff, and the buffer/forget/freeze engine primitives.
+
+Expected outputs for tumbling/sliding/session windows are taken from the reference's
+docstring examples (``/root/reference/python/pathway/stdlib/temporal/_window.py``)
+— they define behavior parity.
+"""
+
+import sys
+
+import pytest
+
+import pathway_tpu as pw
+from utils import assert_rows, assert_stream_consistent, deltas_of, rows_of
+
+
+def test_tumbling_window():
+    t = pw.debug.table_from_markdown('''
+        | instance | t
+    1   | 0        |  12
+    2   | 0        |  13
+    3   | 0        |  14
+    4   | 0        |  15
+    5   | 0        |  16
+    6   | 0        |  17
+    7   | 1        |  10
+    8   | 1        |  11
+    ''')
+    result = t.windowby(
+        t.t, window=pw.temporal.tumbling(duration=5), instance=t.instance
+    ).reduce(
+        pw.this._pw_instance,
+        pw.this._pw_window_start,
+        pw.this._pw_window_end,
+        count=pw.reducers.count(),
+    )
+    assert_rows(result, [(0, 10, 15, 3), (0, 15, 20, 3), (1, 10, 15, 2)])
+
+
+def test_sliding_window_matches_reference_docstring():
+    t = pw.debug.table_from_markdown('''
+        | instance | t
+    1   | 0        |  12
+    2   | 0        |  13
+    3   | 0        |  14
+    4   | 0        |  15
+    5   | 0        |  16
+    6   | 0        |  17
+    7   | 1        |  10
+    8   | 1        |  11
+    ''')
+    result = t.windowby(
+        t.t, window=pw.temporal.sliding(duration=10, hop=3), instance=t.instance
+    ).reduce(
+        pw.this._pw_instance,
+        pw.this._pw_window_start,
+        pw.this._pw_window_end,
+        min_t=pw.reducers.min(pw.this.t),
+        max_t=pw.reducers.max(pw.this.t),
+        count=pw.reducers.count(),
+    )
+    assert_rows(result, [
+        (0, 3, 13, 12, 12, 1),
+        (0, 6, 16, 12, 15, 4),
+        (0, 9, 19, 12, 17, 6),
+        (0, 12, 22, 12, 17, 6),
+        (0, 15, 25, 15, 17, 3),
+        (1, 3, 13, 10, 11, 2),
+        (1, 6, 16, 10, 11, 2),
+        (1, 9, 19, 10, 11, 2),
+    ])
+
+
+def test_session_window_matches_reference_docstring():
+    t = pw.debug.table_from_markdown('''
+        | instance |  t |  v
+    1   | 0        |  1 |  10
+    2   | 0        |  2 |  1
+    3   | 0        |  4 |  3
+    4   | 0        |  8 |  2
+    5   | 0        |  9 |  4
+    6   | 0        |  10|  8
+    7   | 1        |  1 |  9
+    8   | 1        |  2 |  16
+    ''')
+    result = t.windowby(
+        t.t,
+        window=pw.temporal.session(predicate=lambda a, b: abs(a - b) <= 1),
+        instance=t.instance,
+    ).reduce(
+        pw.this._pw_instance,
+        pw.this._pw_window_start,
+        pw.this._pw_window_end,
+        min_t=pw.reducers.min(pw.this.t),
+        max_v=pw.reducers.max(pw.this.v),
+        count=pw.reducers.count(),
+    )
+    assert_rows(result, [
+        (0, 1, 2, 1, 10, 2),
+        (0, 4, 4, 4, 3, 1),
+        (0, 8, 10, 8, 8, 3),
+        (1, 1, 2, 1, 16, 2),
+    ])
+
+
+def test_session_window_max_gap_incremental():
+    """Streamed input: a bridging row merges two sessions; retractions must be
+    consistent."""
+    t = pw.debug.table_from_markdown('''
+        | t | __time__
+    1   | 1 | 2
+    2   | 5 | 2
+    3   | 3 | 4
+    ''')
+    r = t.windowby(t.t, window=pw.temporal.session(max_gap=3)).reduce(
+        pw.this._pw_window_start, cnt=pw.reducers.count()
+    )
+    assert_stream_consistent(r)
+    assert rows_of(r) == {(1, 3): 1}
+
+
+def test_intervals_over():
+    m = pw.debug.table_from_markdown('''
+        | t  | v
+    1   | 1  | 10
+    2   | 3  | 13
+    3   | 7  | 20
+    ''')
+    pts = pw.debug.table_from_markdown('''
+        | p
+    1   | 2
+    2   | 6
+    3   | 100
+    ''')
+    w = pw.temporal.intervals_over(at=pts.p, lower_bound=-2, upper_bound=1, is_outer=True)
+    r = m.windowby(m.t, window=w).reduce(
+        pw.this._pw_window_location,
+        vsum=pw.reducers.sum(pw.this.v),
+        cnt=pw.reducers.count(),
+    )
+    # outer: the point with no rows still appears (cnt counts the padded row)
+    got = {row[0]: row[1] for row in rows_of(r)}
+    assert got[2] == 23 and got[6] == 20
+    assert 100 in got
+
+
+def test_interval_join_inner_and_outer():
+    t1 = pw.debug.table_from_markdown('''
+        | a | t
+    1   | 1 | 3
+    2   | 2 | 4
+    3   | 3 | 7
+    9   | 9 | 100
+    ''')
+    t2 = pw.debug.table_from_markdown('''
+        | b | t
+    1   | 10 | 2
+    2   | 20 | 5
+    3   | 30 | 9
+    ''')
+    inner = t1.interval_join(t2, t1.t, t2.t, pw.temporal.interval(-2, 1)).select(
+        t1.a, t2.b
+    )
+    assert_rows(inner, [(1, 10), (2, 10), (2, 20), (3, 20)])
+    left = pw.temporal.interval_join_left(
+        t1, t2, t1.t, t2.t, pw.temporal.interval(-2, 1)
+    ).select(t1.a, b=pw.coalesce(t2.b, -1))
+    assert_rows(left, [(1, 10), (2, 10), (2, 20), (3, 20), (9, -1)])
+    outer = pw.temporal.interval_join_outer(
+        t1, t2, t1.t, t2.t, pw.temporal.interval(-2, 1)
+    ).select(a=pw.coalesce(t1.a, -1), b=pw.coalesce(t2.b, -1))
+    assert_rows(outer, [(1, 10), (2, 10), (2, 20), (3, 20), (9, -1), (-1, 30)])
+
+
+def test_interval_join_with_on_condition():
+    t1 = pw.debug.table_from_markdown('''
+        | k | t
+    1   | 1 | 3
+    2   | 2 | 3
+    ''')
+    t2 = pw.debug.table_from_markdown('''
+        | k | t | v
+    1   | 1 | 4 | 100
+    2   | 2 | 9 | 200
+    ''')
+    r = t1.interval_join(
+        t2, t1.t, t2.t, pw.temporal.interval(0, 2), t1.k == t2.k
+    ).select(t1.k, t2.v)
+    assert_rows(r, [(1, 100)])
+
+
+def test_interval_join_streaming_retraction():
+    t1 = pw.debug.table_from_markdown('''
+        | a | t | __time__ | __diff__
+    1   | 1 | 3 | 2        | 1
+    1   | 1 | 3 | 6        | -1
+    ''')
+    t2 = pw.debug.table_from_markdown('''
+        | b | t | __time__
+    1   | 10 | 2 | 4
+    ''')
+    r = t1.interval_join(t2, t1.t, t2.t, pw.temporal.interval(-2, 2)).select(t1.a, t2.b)
+    assert_stream_consistent(r)
+    assert rows_of(r) == {}  # joined at t=4, retracted at t=6
+
+
+def test_asof_join_directions():
+    t1 = pw.debug.table_from_markdown('''
+        | a | t
+    1   | 1 | 3
+    2   | 2 | 4
+    3   | 3 | 7
+    ''')
+    t2 = pw.debug.table_from_markdown('''
+        | b | t
+    1   | 10 | 2
+    2   | 20 | 5
+    3   | 30 | 9
+    ''')
+    back = pw.temporal.asof_join(t1, t2, t1.t, t2.t, how="left").select(
+        t1.a, b=pw.coalesce(t2.b, -1)
+    )
+    assert_rows(back, [(1, 10), (2, 10), (3, 20)])
+    fwd = pw.temporal.asof_join(
+        t1, t2, t1.t, t2.t, how="left", direction="forward"
+    ).select(t1.a, b=pw.coalesce(t2.b, -1))
+    assert_rows(fwd, [(1, 20), (2, 20), (3, 30)])
+    near = pw.temporal.asof_join(
+        t1, t2, t1.t, t2.t, how="left", direction="nearest"
+    ).select(t1.a, b=pw.coalesce(t2.b, -1))
+    assert_rows(near, [(1, 10), (2, 20), (3, 20)])
+
+
+def test_asof_join_updates_on_new_right_rows():
+    """A better (later ≤ t) right row arriving must re-match existing lefts."""
+    t1 = pw.debug.table_from_markdown('''
+        | a | t | __time__
+    1   | 1 | 10 | 2
+    ''')
+    t2 = pw.debug.table_from_markdown('''
+        | b | t | __time__
+    1   | 100 | 2 | 2
+    2   | 200 | 8 | 4
+    ''')
+    r = pw.temporal.asof_join(t1, t2, t1.t, t2.t, how="left").select(t1.a, t2.b)
+    assert_stream_consistent(r)
+    assert rows_of(r) == {(1, 200): 1}
+    deltas = deltas_of(r)
+    assert ((2, ) + d[2:3] for d in deltas)  # first match at time 2 then revised
+    assert any(d[2] == -1 for d in deltas)  # old match retracted
+
+
+def test_asof_now_join_does_not_update():
+    """Queries answered as-of-now stay answered even when the right side changes."""
+    queries = pw.debug.table_from_markdown('''
+        | q | __time__
+    1   | 1 | 4
+    ''')
+    state = pw.debug.table_from_markdown('''
+        | k | v | __time__ | __diff__
+    1   | 1 | 100 | 2      | 1
+    1   | 1 | 100 | 6      | -1
+    2   | 1 | 999 | 6      | 1
+    ''')
+    r = queries.asof_now_join(state, queries.q == state.k).select(queries.q, state.v)
+    # the answer captured v=100 at query time and was NOT revised at time 6
+    assert rows_of(r) == {(1, 100): 1}
+    assert all(d[3] != (1, 999) for d in deltas_of(r))
+
+
+def test_intervals_over_no_phantom_rows():
+    """A point whose second bucket copy has no bucket matches must not gain a
+    phantom padded row (code-review regression)."""
+    m = pw.debug.table_from_markdown('''
+        | t  | v
+    1   | 0  | 1
+    2   | 1  | 2
+    ''')
+    pts = pw.debug.table_from_markdown('''
+        | p
+    1   | 1
+    2   | 6
+    ''')
+    w = pw.temporal.intervals_over(at=pts.p, lower_bound=-1, upper_bound=1, is_outer=True)
+    r = m.windowby(m.t, window=w).reduce(
+        pw.this._pw_window_location, cnt=pw.reducers.count()
+    )
+    got = {row[0]: row[1] for row in rows_of(r)}
+    assert got[1] == 2  # exactly t=0 and t=1, no pad
+    assert got[6] == 1  # only the pad row
+
+
+def test_asof_join_defaults():
+    t1 = pw.debug.table_from_markdown('''
+        | a | t
+    1   | 1 | 1
+    ''')
+    t2 = pw.debug.table_from_markdown('''
+        | b | t
+    1   | 10 | 5
+    ''')
+    r = pw.temporal.asof_join(
+        t1, t2, t1.t, t2.t, how="left", defaults={t2.b: -7}
+    ).select(t1.a, t2.b)
+    assert_rows(r, [(1, -7)])
+
+
+def test_session_window_with_behavior():
+    t = pw.debug.table_from_markdown('''
+        | t | __time__
+    1   | 1 | 2
+    2   | 2 | 2
+    ''')
+    r = t.windowby(
+        t.t,
+        window=pw.temporal.session(max_gap=3),
+        behavior=pw.temporal.common_behavior(cutoff=100),
+    ).reduce(pw.this._pw_window_start, cnt=pw.reducers.count())
+    assert rows_of(r) == {(1, 2): 1}
+
+
+def test_datetime_hash_unit_invariance():
+    import numpy as np
+    from pathway_tpu.internals.keys import hash_column, stable_hash_obj
+
+    s = np.datetime64("2020-01-01", "s")
+    ns = np.datetime64("2020-01-01", "ns")
+    assert stable_hash_obj(s) == stable_hash_obj(ns)
+    assert hash_column(np.array([s]))[0] == hash_column(np.array([ns]))[0]
+    obj = np.empty(1, dtype=object)
+    obj[0] = s
+    assert hash_column(obj)[0] == hash_column(np.array([ns]))[0]
+
+
+def test_window_join():
+    t1 = pw.debug.table_from_markdown('''
+        | a | t
+    1   | 1 | 3
+    2   | 2 | 4
+    3   | 3 | 7
+    ''')
+    t2 = pw.debug.table_from_markdown('''
+        | b | t
+    1   | 10 | 2
+    2   | 20 | 5
+    3   | 30 | 9
+    ''')
+    r = pw.temporal.window_join(t1, t2, t1.t, t2.t, pw.temporal.tumbling(4)).select(
+        t1.a, t2.b
+    )
+    assert_rows(r, [(1, 10), (2, 20), (3, 20)])
+
+
+def test_sort_prev_next():
+    t = pw.debug.table_from_markdown('''
+        | x
+    1   | 30
+    2   | 10
+    3   | 20
+    ''')
+    s = t.sort(t.x)
+    joined = t.with_columns(prev=s.prev, next=s.next)
+    rows = {row[0]: (row[1], row[2]) for row in rows_of(joined)}
+    assert rows[10][0] is None and rows[30][1] is None
+    # chase: 10 -> 20 -> 30
+    nxt = t.ix(joined.next, optional=True)
+    vals = {row[0]: row[1] for row in rows_of(t.select(pw.this.x, nx=nxt.x))}
+    assert vals == {10: 20, 20: 30, 30: None}
+
+
+def test_diff():
+    m = pw.debug.table_from_markdown('''
+        | t  | v
+    1   | 1  | 10
+    2   | 3  | 13
+    3   | 7  | 20
+    ''')
+    assert_rows(m.diff(m.t, m.v), [(None,), (3,), (7,)])
+
+
+def test_buffer_releases_on_watermark():
+    s = pw.debug.table_from_markdown('''
+        | t | __time__
+    1   | 5 | 2
+    2   | 1 | 2
+    3   | 9 | 4
+    ''')
+    buffered = s._buffer(pw.this.t + 2, pw.this.t)
+    deltas = deltas_of(buffered)
+    released = {d[3][0]: d[0] for d in deltas}
+    # row t=1 (threshold 3) released once watermark hit 5... watermark updates at
+    # end of tick 2 (max t=5): release at next frontier
+    assert released[1] >= 2
+    assert 5 in released and 9 in released  # flushed by close at the latest
+
+
+def test_forget_retracts_past_cutoff():
+    s = pw.debug.table_from_markdown('''
+        | t | __time__
+    1   | 1 | 2
+    2   | 9 | 4
+    ''')
+    forgotten = s._forget(pw.this.t + 2, pw.this.t)
+    assert_stream_consistent(forgotten)
+    assert rows_of(forgotten) == {(9,): 1}  # t=1 forgotten once watermark=9 > 3
+
+
+def test_freeze_drops_late_rows():
+    s = pw.debug.table_from_markdown('''
+        | t | v | __time__
+    1   | 1 | 1 | 2
+    2   | 9 | 2 | 4
+    3   | 2 | 3 | 6
+    ''')
+    frozen = s._freeze(pw.this.t + 2, pw.this.t)
+    # row t=2 arrives at wall 6 when watermark=9 ≥ threshold 4 → dropped
+    assert rows_of(frozen) == {(1, 1): 1, (9, 2): 1}
+
+
+def test_forget_immediately():
+    s = pw.debug.table_from_markdown('''
+        | q | __time__
+    1   | 7 | 2
+    ''')
+    f = s._forget_immediately()
+    assert_stream_consistent(f)
+    assert rows_of(f) == {}  # inserted then retracted within the next frontier
