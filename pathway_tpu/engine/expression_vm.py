@@ -21,6 +21,7 @@ from pathway_tpu.internals.errors import ERROR
 from pathway_tpu.internals.expression import (
     ApplyExpression,
     AsyncApplyExpression,
+    BatchApplyExpression,
     BinOpExpression,
     CastExpression,
     CoalesceExpression,
@@ -209,6 +210,9 @@ def eval_expr(expr: ColumnExpression, ctx: EvalContext) -> np.ndarray:
     if isinstance(expr, AsyncApplyExpression):
         return _eval_async_apply(expr, ctx)
 
+    if isinstance(expr, BatchApplyExpression):
+        return _eval_batch_apply(expr, ctx)
+
     if isinstance(expr, ApplyExpression):
         return _eval_apply(expr, ctx)
 
@@ -314,6 +318,49 @@ def _eval_apply(expr: ApplyExpression, ctx: EvalContext) -> np.ndarray:
             out[i] = fn(*args, **kwargs)
         except Exception:
             out[i] = ERROR
+    return _tighten(out, expr.return_type)
+
+
+def _eval_batch_apply(expr: "BatchApplyExpression", ctx: EvalContext) -> np.ndarray:
+    """One call over the whole block: fn(col0_list, col1_list, ...) -> list."""
+    arrays = [eval_expr(a, ctx) for a in expr.args_]
+    kw_names = list(expr.kwargs_.keys())
+    kw_arrays = [eval_expr(expr.kwargs_[k], ctx) for k in kw_names]
+    all_arrays = list(arrays) + kw_arrays
+    out = np.empty(ctx.n, dtype=object)
+    run: list[int] = []
+    for i in range(ctx.n):
+        if any(a[i] is ERROR for a in all_arrays):
+            out[i] = ERROR
+        elif expr.propagate_none and any(a[i] is None for a in all_arrays):
+            out[i] = None
+        else:
+            run.append(i)
+    idx = np.asarray(run, dtype=np.int64)
+    if len(idx):
+        args = [[arr[i] for i in idx] for arr in arrays]
+        kwargs = {k: [arr[i] for i in idx] for k, arr in zip(kw_names, kw_arrays)}
+        try:
+            results = expr.fn(*args, **kwargs)
+            if len(results) != len(idx):
+                raise ValueError(
+                    f"batch UDF returned {len(results)} results for {len(idx)} rows"
+                )
+            for j, i in enumerate(idx):
+                out[i] = results[j]
+        except Exception:
+            # row isolation: retry each row alone so one bad input doesn't error
+            # the whole block (matches per-row ApplyExpression semantics; the
+            # batch is already on the failing path so the cost is irrelevant)
+            for i in idx:
+                try:
+                    r = expr.fn(
+                        *[[arr[i]] for arr in arrays],
+                        **{k: [arr[i]] for k, arr in zip(kw_names, kw_arrays)},
+                    )
+                    out[i] = r[0]
+                except Exception:
+                    out[i] = ERROR
     return _tighten(out, expr.return_type)
 
 

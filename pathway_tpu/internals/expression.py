@@ -468,6 +468,12 @@ class AsyncApplyExpression(ApplyExpression):
     reference's one-boxed-future-per-row (``src/engine/dataflow.rs:1924-1962``)."""
 
 
+class BatchApplyExpression(ApplyExpression):
+    """``fn`` receives whole columns (lists, one per arg) and returns a list —
+    the dispatch shape for TPU model UDFs (embedders/rerankers): one jitted call
+    per delta block instead of a Python call per row."""
+
+
 class FullyAsyncApplyExpression(ApplyExpression):
     """Returns Pending immediately, result arrives as a later update."""
 

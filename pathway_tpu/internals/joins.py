@@ -76,13 +76,17 @@ class JoinResult:
 
         l_cols = left.column_names()
         r_cols = right.column_names()
+        # empty `on` = cross join (reference: statistics-style joins against a
+        # 1-row aggregate); PointerExpression with no args would key per row
+        l_jk = expr_mod.PointerExpression(left, *self.left_on) if self.left_on else 0
+        r_jk = expr_mod.PointerExpression(right, *self.right_on) if self.right_on else 0
         pre_l = left.select(
             **{f"__v_{n}": left[n] for n in l_cols},
-            __jk__=expr_mod.PointerExpression(left, *self.left_on),
+            __jk__=l_jk,
         )
         pre_r = right.select(
             **{f"__v_{n}": right[n] for n in r_cols},
-            __jk__=expr_mod.PointerExpression(right, *self.right_on),
+            __jk__=r_jk,
         )
         out_columns = (
             ["__left_id__", "__right_id__"]

@@ -119,8 +119,9 @@ def avg(expr: ColumnExpression) -> ColumnExpression:
 def tuple(expr: ColumnExpression, *, skip_nones: bool = False) -> ReducerExpression:  # noqa: A001
     r = Reducer(
         "tuple",
-        lambda dts: impl.TupleReducer(skip_nones=skip_nones),
+        lambda dts: impl.TupleReducer(skip_nones=skip_nones, with_sort_key=True),
         lambda dts: dt.List(_first(dts)),
+        append_sort_key=True,  # honors groupby(sort_by=...); defaults to row id
     )
     return ReducerExpression(r, expr)
 

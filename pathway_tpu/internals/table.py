@@ -606,7 +606,23 @@ class Table:
 
     @staticmethod
     def from_columns(*args: Any, **kwargs: Any) -> "Table":
-        raise NotImplementedError("use pw.debug.table_from_pandas")
+        """Build a table from column references sharing one universe
+        (reference ``internals/table.py`` from_columns)."""
+        exprs: dict[str, Any] = {}
+        for a in args:
+            if not isinstance(a, ColumnReference):
+                raise ValueError("from_columns positional args must be column refs")
+            exprs[a.name] = a
+        exprs.update(kwargs)
+        source = None
+        for e in exprs.values():
+            t = _table_of(expr_mod.wrap(e))
+            if t is not None:
+                source = t
+                break
+        if source is None:
+            raise ValueError("from_columns needs at least one column reference")
+        return source.select(**exprs)
 
 
 def _table_of(e: Any) -> Table | None:

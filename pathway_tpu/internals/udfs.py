@@ -279,6 +279,14 @@ class UDF:
                 propagate_none=self.propagate_none,
                 deterministic=self.deterministic,
             )
+        if getattr(self, "is_batched", False):
+            # fn receives whole columns (lists) — TPU model UDFs (one jitted call
+            # per delta block); caching/retry wrappers don't apply per row
+            return expr_mod.BatchApplyExpression(
+                self._resolve_fn(), rt, args=args, kwargs=kwargs,
+                propagate_none=self.propagate_none,
+                deterministic=self.deterministic,
+            )
         return expr_mod.ApplyExpression(
             fn, rt, args=args, kwargs=kwargs,
             propagate_none=self.propagate_none,

@@ -1,1 +1,42 @@
-"""Placeholder — implemented in a later milestone."""
+"""Indexing stdlib (reference ``python/pathway/stdlib/indexing/``): KNN / BM25 /
+hybrid inner indexes, DataIndex payload joins, and retriever factories.
+
+The vector path is the TPU north star: the index matrix lives in device HBM and
+search is a jitted einsum + top_k (``pathway_tpu/ops/knn.py``).
+"""
+
+from pathway_tpu.stdlib.indexing.bm25 import BM25, TantivyBM25
+from pathway_tpu.stdlib.indexing.data_index import DataIndex, InnerIndex, _SCORE
+from pathway_tpu.stdlib.indexing.hybrid_index import HybridIndex
+from pathway_tpu.stdlib.indexing.nearest_neighbors import (
+    BruteForceKnn,
+    DistanceMetric,
+    LshKnn,
+    UsearchKnn,
+)
+from pathway_tpu.stdlib.indexing.retrievers import (
+    AbstractRetrieverFactory,
+    BruteForceKnnFactory,
+    HybridIndexFactory,
+    LshKnnFactory,
+    TantivyBM25Factory,
+    UsearchKnnFactory,
+)
+
+__all__ = [
+    "AbstractRetrieverFactory",
+    "BM25",
+    "BruteForceKnn",
+    "BruteForceKnnFactory",
+    "DataIndex",
+    "DistanceMetric",
+    "HybridIndex",
+    "HybridIndexFactory",
+    "InnerIndex",
+    "LshKnn",
+    "LshKnnFactory",
+    "TantivyBM25",
+    "TantivyBM25Factory",
+    "UsearchKnn",
+    "UsearchKnnFactory",
+]

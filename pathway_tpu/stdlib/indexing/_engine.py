@@ -1,0 +1,271 @@
+"""External-index engine node + index backends.
+
+Counterpart of the reference's ``use_external_index_as_of_now`` machinery
+(``src/engine/dataflow/operators/external_index.rs:81`` + ``external_integration/``):
+the index lives OUTSIDE the incremental state, updated by the doc stream's
+additions/retractions and queried per query row. Two query disciplines:
+
+- **as-of-now** (reference behavior): each query is answered against the index
+  state at its arrival tick and the answer is never revised; query retractions
+  retract their answers.
+- **consistent** (reference's pure-dataflow LshKnn ``query``): answers are kept
+  up to date — when docs change, all live queries are re-answered and deltas
+  emitted. On TPU this is the natural mode for the brute-force index: re-answering
+  every query is ONE batched einsum (``ops/knn.py``), not a per-query loop.
+
+Backends: ``VectorBackend`` (ops.knn HBM index), ``BM25Backend`` (host-side
+inverted index — memory-bound, not FLOP-bound, so it stays on host like the
+reference's tantivy).
+"""
+
+from __future__ import annotations
+
+import math
+from collections import defaultdict
+from typing import Any, Callable
+
+import numpy as np
+
+from pathway_tpu.engine.blocks import DeltaBatch
+from pathway_tpu.engine.graph import Node
+from pathway_tpu.stdlib.indexing._filters import compile_filter
+
+
+class IndexBackend:
+    """add/remove/search over (key, item, metadata) triples."""
+
+    def add(self, key: int, item: Any, metadata: Any) -> None:
+        raise NotImplementedError
+
+    def remove(self, key: int) -> None:
+        raise NotImplementedError
+
+    def search(
+        self, items: list[Any], ks: list[int], filters: list[Callable[[Any], bool]]
+    ) -> list[list[tuple[int, float]]]:
+        """Per query: top-k (doc_key, score) pairs, best (highest score) first."""
+        raise NotImplementedError
+
+
+class VectorBackend(IndexBackend):
+    """Dense KNN over the HBM-resident brute-force index (ops/knn.py)."""
+
+    def __init__(self, dimension: int, metric: str = "cos", reserved_space: int = 1024):
+        from pathway_tpu.ops.knn import BruteForceKnnIndex
+
+        self.index = BruteForceKnnIndex(
+            dimension=dimension, metric=metric, capacity=max(reserved_space, 128)
+        )
+        self.metadata: dict[int, Any] = {}
+
+    def add(self, key, item, metadata):
+        self.index.add(key, np.asarray(item, dtype=np.float32))
+        self.metadata[key] = metadata
+
+    def remove(self, key):
+        self.index.remove(key)
+        self.metadata.pop(key, None)
+
+    def search(self, items, ks, filters):
+        if not items:
+            return []
+        n_live = len(self.index)
+        if n_live == 0:
+            return [[] for _ in items]
+        kmax = max(ks, default=0)
+        # over-fetch so post-filtering still fills k; filters are rare and the
+        # einsum cost is independent of k
+        fetch = min(n_live, max(kmax * 10, kmax))
+        batch = np.stack([np.asarray(q, dtype=np.float32) for q in items])
+        raw = self.index.search(batch, fetch)
+        out = []
+        for hits, k, flt in zip(raw, ks, filters):
+            picked = []
+            for key, score in hits:
+                if flt(self.metadata.get(key)):
+                    picked.append((key, float(score)))
+                if len(picked) == k:
+                    break
+            out.append(picked)
+        return out
+
+
+class BM25Backend(IndexBackend):
+    """Okapi BM25 over a host-side inverted index (k1=1.2, b=0.75)."""
+
+    K1 = 1.2
+    B = 0.75
+
+    def __init__(self):
+        self.docs: dict[int, dict[str, int]] = {}
+        self.metadata: dict[int, Any] = {}
+        self.doc_len: dict[int, int] = {}
+        self.postings: dict[str, dict[int, int]] = defaultdict(dict)
+        self.total_len = 0
+
+    @staticmethod
+    def _tokens(text: str) -> list[str]:
+        import re
+
+        return re.findall(r"[a-z0-9]+", str(text).lower())
+
+    def add(self, key, item, metadata):
+        toks = self._tokens(item)
+        tf: dict[str, int] = defaultdict(int)
+        for t in toks:
+            tf[t] += 1
+        self.docs[key] = dict(tf)
+        self.metadata[key] = metadata
+        self.doc_len[key] = len(toks)
+        self.total_len += len(toks)
+        for t, c in tf.items():
+            self.postings[t][key] = c
+
+    def remove(self, key):
+        tf = self.docs.pop(key, None)
+        if tf is None:
+            return
+        self.metadata.pop(key, None)
+        self.total_len -= self.doc_len.pop(key, 0)
+        for t in tf:
+            self.postings[t].pop(key, None)
+            if not self.postings[t]:
+                del self.postings[t]
+
+    def search(self, items, ks, filters):
+        n = len(self.docs)
+        out = []
+        avgdl = (self.total_len / n) if n else 1.0
+        for query, k, flt in zip(items, ks, filters):
+            scores: dict[int, float] = defaultdict(float)
+            for t in self._tokens(query):
+                posting = self.postings.get(t)
+                if not posting:
+                    continue
+                idf = math.log(1 + (n - len(posting) + 0.5) / (len(posting) + 0.5))
+                for key, tf in posting.items():
+                    dl = self.doc_len[key] or 1
+                    scores[key] += (
+                        idf
+                        * tf
+                        * (self.K1 + 1)
+                        / (tf + self.K1 * (1 - self.B + self.B * dl / avgdl))
+                    )
+            ranked = sorted(scores.items(), key=lambda kv: (-kv[1], kv[0]))
+            picked = [
+                (key, float(s)) for key, s in ranked if flt(self.metadata.get(key))
+            ][:k]
+            out.append(picked)
+        return out
+
+
+class ExternalIndexNode(Node):
+    """input0 = docs (item, metadata); input1 = queries (item, k, filter).
+
+    Emits one reply row per query: ``_pw_index_reply`` = tuple of (doc_key, score),
+    keyed by the query's own key (universe of replies == universe of queries).
+    """
+
+    name = "external_index"
+
+    def __init__(self, backend_factory: Callable[[], IndexBackend], as_of_now: bool):
+        super().__init__(n_inputs=2)
+        self.backend = backend_factory()
+        self.as_of_now = as_of_now
+        self._live_queries: dict[int, tuple[Any, int, str | None]] = {}
+        self._emitted: dict[int, tuple] = {}  # query key -> reply tuple emitted
+        self._filter_cache: dict[str | None, Callable] = {}
+
+    def _filter(self, expr):
+        if expr not in self._filter_cache:
+            try:
+                self._filter_cache[expr] = compile_filter(expr)
+            except Exception:
+                # a malformed user-supplied filter poisons only its own query
+                # (empty reply), never the dataflow — one bad HTTP request must
+                # not kill the server
+                self._filter_cache[expr] = None
+        return self._filter_cache[expr]
+
+    def _answer(self, keys: list[int]) -> list[tuple]:
+        qs = [self._live_queries[k] for k in keys]
+        filters = [self._filter(q[2]) for q in qs]
+        good = [i for i, f in enumerate(filters) if f is not None]
+        replies: list[tuple] = [()] * len(qs)  # bad-filter queries reply empty
+        if good:
+            answered = self.backend.search(
+                [qs[i][0] for i in good],
+                [qs[i][1] for i in good],
+                [filters[i] for i in good],
+            )
+            for i, r in zip(good, answered):
+                replies[i] = tuple(r)
+        return replies
+
+    def process(self, inputs, time):
+        docs, queries = inputs
+        docs_changed = False
+        if docs is not None:
+            for i in range(len(docs)):
+                key = int(docs.keys[i])
+                if docs.diffs[i] > 0:
+                    self.backend.add(key, docs.data["__item"][i], docs.data["__meta"][i])
+                else:
+                    self.backend.remove(key)
+            docs_changed = len(docs) > 0
+
+        out_keys: list[int] = []
+        out_diffs: list[int] = []
+        out_rows: list[tuple] = []
+
+        def emit(k, reply, diff):
+            out_keys.append(k)
+            out_diffs.append(diff)
+            out_rows.append((reply,))
+
+        new_queries: list[int] = []
+        if queries is not None:
+            for i in range(len(queries)):
+                k = int(queries.keys[i])
+                if queries.diffs[i] > 0:
+                    self._live_queries[k] = (
+                        queries.data["__item"][i],
+                        int(queries.data["__k"][i]),
+                        queries.data["__filter"][i]
+                        if "__filter" in queries.data
+                        else None,
+                    )
+                    new_queries.append(k)
+                else:
+                    self._live_queries.pop(k, None)
+                    old = self._emitted.pop(k, None)
+                    if old is not None:
+                        emit(k, old, -1)
+
+        if self.as_of_now:
+            to_answer = new_queries
+        else:
+            # consistent mode: docs changed → re-answer every live query (one
+            # batched search — TPU-friendly), else just the new ones
+            to_answer = list(self._live_queries) if docs_changed else new_queries
+        if to_answer:
+            replies = self._answer(to_answer)
+            for k, reply in zip(to_answer, replies):
+                old = self._emitted.get(k)
+                if old == reply:
+                    continue
+                if old is not None:
+                    emit(k, old, -1)
+                emit(k, reply, +1)
+                self._emitted[k] = reply
+        if self.as_of_now:
+            # answered queries need no further tracking (they are never revised)
+            for k in to_answer:
+                self._live_queries.pop(k, None)
+        if not out_keys:
+            return []
+        return [
+            DeltaBatch.from_rows(
+                out_keys, out_rows, ["_pw_index_reply"], time, diffs=out_diffs
+            )
+        ]

@@ -1,0 +1,72 @@
+"""KNN inner indexes (reference ``stdlib/indexing/nearest_neighbors.py:65-262``).
+
+``BruteForceKnn`` is the TPU-native flagship: the ``[N, d]`` matrix lives in device
+HBM, search is a jitted einsum + top_k (``pathway_tpu/ops/knn.py``). ``LshKnn`` and
+``UsearchKnn`` map onto the same backend — on TPU the brute-force einsum is faster
+than host-side HNSW/LSH graph walks until far larger corpus sizes, so the
+approximate variants keep the reference API while sharing the exact backend (the
+reference's LshKnn exists to give a *consistent* ``query``; here both disciplines
+are served by the engine node, see ``_engine.py``).
+"""
+
+from __future__ import annotations
+
+import enum
+from typing import Any, Callable
+
+import numpy as np
+
+from pathway_tpu.internals.expression import ColumnExpression, ColumnReference
+from pathway_tpu.stdlib.indexing._engine import VectorBackend
+from pathway_tpu.stdlib.indexing.data_index import InnerIndex
+
+
+class DistanceMetric(enum.Enum):
+    COS = "cos"
+    L2SQ = "l2sq"
+    DOT = "dot"
+
+
+def _embedder_transform(embedder):
+    """Wrap a text column into vectors via the embedder UDF (batched at the UDF
+    layer — ops/microbatch.py — not per row like the reference)."""
+    if embedder is None:
+        return None
+
+    def transform(table, expr):
+        return embedder(expr)
+
+    return transform
+
+
+class BruteForceKnn(InnerIndex):
+    def __init__(
+        self,
+        data_column: ColumnReference,
+        dimensions: int,
+        *,
+        reserved_space: int = 1024,
+        metric: DistanceMetric | str = DistanceMetric.COS,
+        metadata_column: ColumnExpression | None = None,
+        embedder=None,
+    ):
+        metric_val = metric.value if isinstance(metric, DistanceMetric) else str(metric)
+        transform = _embedder_transform(embedder)
+        super().__init__(
+            data_column,
+            metadata_column=metadata_column,
+            backend_factory=lambda: VectorBackend(
+                dimension=dimensions, metric=metric_val, reserved_space=reserved_space
+            ),
+            item_transform=transform,
+        )
+        self.dimensions = dimensions
+        self.metric = metric_val
+
+
+class LshKnn(BruteForceKnn):
+    """Reference API parity; served by the exact HBM backend (see module note)."""
+
+
+class UsearchKnn(BruteForceKnn):
+    """Reference API parity; served by the exact HBM backend (see module note)."""

@@ -1,0 +1,99 @@
+"""Retriever factories (reference ``stdlib/indexing/retrievers.py`` +
+``nearest_neighbors.py:407-565``): deferred index construction so apps (e.g.
+DocumentStore) can be configured with *how* to index before the data tables exist.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Callable
+
+from pathway_tpu.internals.expression import ColumnExpression, ColumnReference
+from pathway_tpu.internals.table import Table
+from pathway_tpu.stdlib.indexing.bm25 import TantivyBM25
+from pathway_tpu.stdlib.indexing.data_index import DataIndex
+from pathway_tpu.stdlib.indexing.hybrid_index import HybridIndex
+from pathway_tpu.stdlib.indexing.nearest_neighbors import (
+    BruteForceKnn,
+    DistanceMetric,
+    LshKnn,
+    UsearchKnn,
+)
+
+
+class AbstractRetrieverFactory:
+    def build_index(
+        self,
+        data_column: ColumnReference,
+        data_table: Table,
+        metadata_column: ColumnExpression | None = None,
+    ) -> DataIndex:
+        raise NotImplementedError
+
+
+@dataclass
+class BruteForceKnnFactory(AbstractRetrieverFactory):
+    dimensions: int | None = None
+    reserved_space: int = 1024
+    embedder: Any = None
+    metric: DistanceMetric | str = DistanceMetric.COS
+    _index_cls: type = BruteForceKnn
+
+    def _resolved_dimensions(self) -> int:
+        if self.dimensions is not None:
+            return self.dimensions
+        dim = getattr(self.embedder, "dimension", None)
+        if callable(dim):
+            dim = dim()
+        if dim is None:
+            raise ValueError("provide dimensions= or an embedder exposing .dimension")
+        return int(dim)
+
+    def build_index(self, data_column, data_table, metadata_column=None) -> DataIndex:
+        inner = self._index_cls(
+            data_column,
+            self._resolved_dimensions(),
+            reserved_space=self.reserved_space,
+            metric=self.metric,
+            metadata_column=metadata_column,
+            embedder=self.embedder,
+        )
+        return DataIndex(data_table, inner)
+
+
+@dataclass
+class LshKnnFactory(BruteForceKnnFactory):
+    _index_cls: type = LshKnn
+
+
+@dataclass
+class UsearchKnnFactory(BruteForceKnnFactory):
+    _index_cls: type = UsearchKnn
+
+
+@dataclass
+class TantivyBM25Factory(AbstractRetrieverFactory):
+    ram_budget: int | None = None
+    in_memory_index: bool = True
+
+    def build_index(self, data_column, data_table, metadata_column=None) -> DataIndex:
+        inner = TantivyBM25(
+            data_column,
+            metadata_column=metadata_column,
+            ram_budget=self.ram_budget,
+            in_memory_index=self.in_memory_index,
+        )
+        return DataIndex(data_table, inner)
+
+
+@dataclass
+class HybridIndexFactory(AbstractRetrieverFactory):
+    retriever_factories: list[AbstractRetrieverFactory] = field(default_factory=list)
+    k: float = 60.0
+
+    def build_index(self, data_column, data_table, metadata_column=None) -> DataIndex:
+        inners = [
+            f.build_index(data_column, data_table, metadata_column).inner_index
+            for f in self.retriever_factories
+        ]
+        return DataIndex(data_table, HybridIndex(inners, k=self.k))

@@ -1,0 +1,35 @@
+"""LLM xpack (reference ``python/pathway/xpacks/llm/``): embedders, rerankers,
+chats, parsers, splitters, DocumentStore, vector store, Adaptive RAG, servers.
+
+TPU-native compute: the local embedder and cross-encoder run as batched jitted
+JAX models (``pathway_tpu/ops/``), not per-row torch calls.
+"""
+
+from pathway_tpu.xpacks.llm import (
+    embedders,
+    llms,
+    mocks,
+    parsers,
+    prompts,
+    question_answering,
+    rerankers,
+    servers,
+    splitters,
+    vector_store,
+)
+from pathway_tpu.xpacks.llm.document_store import DocumentStore, SlidesDocumentStore
+
+__all__ = [
+    "DocumentStore",
+    "SlidesDocumentStore",
+    "embedders",
+    "llms",
+    "mocks",
+    "parsers",
+    "prompts",
+    "question_answering",
+    "rerankers",
+    "servers",
+    "splitters",
+    "vector_store",
+]

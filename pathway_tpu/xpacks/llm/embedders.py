@@ -1,0 +1,139 @@
+"""Embedders (reference ``xpacks/llm/embedders.py:88-440``).
+
+The reference's ``SentenceTransformerEmbedder`` calls torch ``model.encode(input)``
+**once per row** (``:385-398``) — TPU target #1 per SURVEY. Here the local model is
+the pure-JAX transformer (``pathway_tpu/ops/encoder.py``) behind a **batched** UDF:
+the engine hands the whole delta block's texts to one jitted forward pass
+(``BatchApplyExpression``), padded to power-of-two buckets so the compile cache
+hits. Remote-API embedders (OpenAI/LiteLLM/Gemini) keep the async-UDF path with
+capacity/retry wrappers; they gate on their client libraries at construction.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import numpy as np
+
+from pathway_tpu.internals.udfs import UDF, AsyncExecutor
+
+
+class BaseEmbedder(UDF):
+    """Text → vector UDF; exposes the embedding dimension for index factories."""
+
+    def get_embedding_dimension(self, **kwargs) -> int:
+        raise NotImplementedError
+
+    @property
+    def dimension(self) -> int:
+        return self.get_embedding_dimension()
+
+
+class SentenceTransformerEmbedder(BaseEmbedder):
+    """JAX sentence encoder on TPU; batched per delta block.
+
+    ``model`` selects an :class:`~pathway_tpu.ops.encoder.EncoderConfig` preset
+    (``"minilm"`` 384-d default) or accepts a config instance. Weights are
+    deterministic from ``seed`` (no external checkpoint download in this image);
+    load real weights via ``params=`` when available.
+    """
+
+    is_batched = True
+
+    _PRESETS = {
+        "minilm": dict(d_model=384, n_heads=6, n_layers=6, d_ff=1536),
+        "small": dict(d_model=256, n_heads=4, n_layers=4, d_ff=1024),
+        "tiny": dict(d_model=128, n_heads=4, n_layers=2, d_ff=512),
+    }
+
+    def __init__(
+        self,
+        model: Any = "minilm",
+        *,
+        seed: int = 0,
+        params: Any = None,
+        **kwargs,
+    ):
+        from pathway_tpu.ops.encoder import EncoderConfig, JaxSentenceEncoder
+
+        if isinstance(model, EncoderConfig):
+            cfg = model
+        else:
+            preset = self._PRESETS.get(str(model), self._PRESETS["minilm"])
+            cfg = EncoderConfig(**preset)
+        self._encoder = JaxSentenceEncoder(cfg, seed=seed)
+        if params is not None:
+            self._encoder.params = params
+        encoder = self._encoder
+
+        def embed_batch(texts: list[str]) -> list[np.ndarray]:
+            embs = encoder.encode_texts([str(t) for t in texts])
+            return list(embs)
+
+        super().__init__(_fn=embed_batch, return_type=np.ndarray, **kwargs)
+
+    def get_embedding_dimension(self, **kwargs) -> int:
+        return self._encoder.dimension
+
+
+class JaxEmbedder(SentenceTransformerEmbedder):
+    """Native name for the TPU embedder (SentenceTransformerEmbedder is the
+    compatibility alias matching the reference API)."""
+
+
+def _require(module: str, cls: str):
+    try:
+        return __import__(module)
+    except ImportError as e:
+        raise ImportError(
+            f"{cls} requires the `{module}` package, which is not available in "
+            f"this environment; use SentenceTransformerEmbedder (TPU-native) instead"
+        ) from e
+
+
+class OpenAIEmbedder(BaseEmbedder):
+    """Remote OpenAI embeddings (reference ``embedders.py:88``); async UDF."""
+
+    def __init__(self, model: str = "text-embedding-3-small", capacity: int | None = None, **openai_kwargs):
+        _require("openai", "OpenAIEmbedder")
+        import openai
+
+        self.model = model
+        client = openai.AsyncOpenAI(
+            **{k: v for k, v in openai_kwargs.items() if k in ("api_key", "base_url")}
+        )
+        extra = {k: v for k, v in openai_kwargs.items() if k not in ("api_key", "base_url")}
+
+        async def embed(text: str) -> np.ndarray:
+            r = await client.embeddings.create(input=[text or "."], model=model, **extra)
+            return np.asarray(r.data[0].embedding, dtype=np.float32)
+
+        super().__init__(_fn=embed, return_type=np.ndarray, executor=AsyncExecutor(capacity=capacity))
+
+    def get_embedding_dimension(self, **kwargs) -> int:
+        return {"text-embedding-3-small": 1536, "text-embedding-3-large": 3072,
+                "text-embedding-ada-002": 1536}.get(self.model, 1536)
+
+
+class LiteLLMEmbedder(BaseEmbedder):
+    def __init__(self, model: str, capacity: int | None = None, **kwargs):
+        _require("litellm", "LiteLLMEmbedder")
+        import litellm
+
+        async def embed(text: str) -> np.ndarray:
+            r = await litellm.aembedding(model=model, input=[text or "."], **kwargs)
+            return np.asarray(r.data[0]["embedding"], dtype=np.float32)
+
+        super().__init__(_fn=embed, return_type=np.ndarray, executor=AsyncExecutor(capacity=capacity))
+
+
+class GeminiEmbedder(BaseEmbedder):
+    def __init__(self, model: str = "models/embedding-001", capacity: int | None = None, **kwargs):
+        _require("google.generativeai", "GeminiEmbedder")
+        import google.generativeai as genai
+
+        async def embed(text: str) -> np.ndarray:
+            r = genai.embed_content(model=model, content=text or ".", **kwargs)
+            return np.asarray(r["embedding"], dtype=np.float32)
+
+        super().__init__(_fn=embed, return_type=np.ndarray, executor=AsyncExecutor(capacity=capacity))

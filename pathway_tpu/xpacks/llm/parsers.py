@@ -1,0 +1,49 @@
+"""Document parsers (reference ``xpacks/llm/parsers.py:46-955``).
+
+Parsers are UDFs ``bytes -> list[(text, metadata)]``. ``Utf8Parser`` is native;
+the heavyweight ones (Unstructured, Docling, vision-LLM Image/Slide parsers,
+pypdf) gate on their libraries at construction, since none ship in this image.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from pathway_tpu.internals.udfs import UDF
+
+
+class Utf8Parser(UDF):
+    """Decode UTF-8 bytes into one text chunk (reference ``parsers.py:46``)."""
+
+    def __init__(self, **kwargs):
+        def parse(contents: Any) -> list:
+            if isinstance(contents, bytes):
+                text = contents.decode("utf-8", errors="replace")
+            else:
+                text = str(contents)
+            return [(text, {})]
+
+        super().__init__(_fn=parse, return_type=list, **kwargs)
+
+
+ParseUtf8 = Utf8Parser  # deprecated reference alias
+
+
+def _gated(name: str, module: str):
+    class _Gated(UDF):
+        def __init__(self, *args, **kwargs):
+            raise ImportError(
+                f"{name} requires the `{module}` package, which is not available "
+                f"in this environment; use Utf8Parser or a custom UDF parser"
+            )
+
+    _Gated.__name__ = name
+    return _Gated
+
+
+UnstructuredParser = _gated("UnstructuredParser", "unstructured")
+ParseUnstructured = UnstructuredParser
+DoclingParser = _gated("DoclingParser", "docling")
+PypdfParser = _gated("PypdfParser", "pypdf")
+ImageParser = _gated("ImageParser", "openparse")
+SlideParser = _gated("SlideParser", "openparse")

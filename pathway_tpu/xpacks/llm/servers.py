@@ -1,0 +1,89 @@
+"""REST servers for RAG apps (reference ``xpacks/llm/servers.py:16-193``).
+
+``BaseRestServer`` wraps ``pw.io.http.rest_connector`` routes; subclasses
+register the DocumentStore / QA endpoints the reference exposes
+(``/v1/retrieve``, ``/v1/statistics``, ``/v1/inputs``, ``/v2/answer``,
+``/v2/summarize``, ``/v2/list_documents``).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable
+
+import pathway_tpu as pw
+from pathway_tpu.io.http._server import PathwayWebserver, rest_connector
+
+
+class BaseRestServer:
+    def __init__(self, host: str, port: int, **kwargs):
+        self.host = host
+        self.port = port
+        self.webserver = PathwayWebserver(host=host, port=port)
+
+    def serve(self, route: str, schema, handler: Callable, **kwargs) -> None:
+        queries, writer = rest_connector(
+            webserver=self.webserver, route=route, schema=schema, methods=("GET", "POST")
+        )
+        writer(handler(queries))
+
+    def run(self, threaded: bool = False, with_cache: bool = False, **kwargs):
+        """Build & run the dataflow (blocks; threaded=True runs in a thread)."""
+        if threaded:
+            t = threading.Thread(target=pw.run, kwargs=dict(**kwargs), daemon=True)
+            t.start()
+            return t
+        return pw.run(**kwargs)
+
+
+class DocumentStoreServer(BaseRestServer):
+    """Reference ``servers.py:92``: retrieve/statistics/inputs endpoints."""
+
+    def __init__(self, host: str, port: int, document_store, **kwargs):
+        super().__init__(host, port, **kwargs)
+        self.document_store = document_store
+        self.serve(
+            "/v1/retrieve",
+            document_store.RetrieveQuerySchema,
+            document_store.retrieve_query,
+        )
+        self.serve(
+            "/v1/statistics",
+            document_store.StatisticsQuerySchema,
+            document_store.statistics_query,
+        )
+        self.serve(
+            "/v1/inputs",
+            document_store.InputsQuerySchema,
+            document_store.inputs_query,
+        )
+
+
+class QARestServer(DocumentStoreServer):
+    """Reference ``servers.py:140``: adds /v2/answer + /v2/list_documents."""
+
+    def __init__(self, host: str, port: int, rag_question_answerer, **kwargs):
+        super().__init__(host, port, rag_question_answerer.indexer, **kwargs)
+        self.rag = rag_question_answerer
+        self.serve(
+            "/v2/answer",
+            rag_question_answerer.AnswerQuerySchema,
+            rag_question_answerer.answer_query,
+        )
+        self.serve(
+            "/v2/list_documents",
+            self.document_store.InputsQuerySchema,
+            self.document_store.inputs_query,
+        )
+
+
+class QASummaryRestServer(QARestServer):
+    """Reference ``servers.py:193``: adds /v2/summarize."""
+
+    def __init__(self, host: str, port: int, rag_question_answerer, **kwargs):
+        super().__init__(host, port, rag_question_answerer, **kwargs)
+        self.serve(
+            "/v2/summarize",
+            rag_question_answerer.SummarizeQuerySchema,
+            rag_question_answerer.summarize_query,
+        )
