@@ -38,6 +38,7 @@ class Runtime:
         self.autocommit_duration_ms = autocommit_duration_ms
         self.monitoring_level = monitoring_level
         self.scheduler: Scheduler | None = None
+        self.persistence: Any = None  # set by pathway_tpu.persistence.attach
         self._stop_requested = False
 
     def register_connector(self, driver: ConnectorDriver) -> None:
@@ -51,6 +52,12 @@ class Runtime:
         scheduler = Scheduler(ctx.graph)
         self.scheduler = scheduler
 
+        if self.persistence is not None:
+            # replay snapshots into input nodes before live reads (reference:
+            # rewind to sentinel, then seek, src/connectors/mod.rs:100-105)
+            self.persistence.on_graph_built(ctx)
+            scheduler.on_tick_done.append(self.persistence.on_tick_done)
+
         for driver in self.connectors:
             driver.start()
 
@@ -58,6 +65,8 @@ class Runtime:
             # static mode: single batch tick
             scheduler.run_tick(0)
             scheduler.close()
+            if self.persistence is not None:
+                self.persistence.on_close()
             return scheduler
 
         tick = 0
@@ -79,4 +88,6 @@ class Runtime:
             for driver in self.connectors:
                 driver.stop()
         scheduler.close()
+        if self.persistence is not None:
+            self.persistence.on_close()
         return scheduler

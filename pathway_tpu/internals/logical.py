@@ -44,6 +44,7 @@ class BuildContext:
     def __init__(self, runtime: Any = None):
         self.graph = EngineGraph()
         self.built: dict[int, Node] = {}
+        self.build_order: list[tuple[LogicalNode, Node]] = []
         self.runtime = runtime
         self.hooks: list[tuple[LogicalNode, Node]] = []
 
@@ -56,6 +57,7 @@ class BuildContext:
         node.name = lnode.name
         self.graph.add_node(node, engine_inputs)
         self.built[id(lnode)] = node
+        self.build_order.append((lnode, node))
         if lnode.runtime_hook is not None:
             self.hooks.append((lnode, node))
         return node

@@ -1,0 +1,132 @@
+"""Persistence KV backends (reference ``src/persistence/backends/``: file, s3,
+memory, mock — a flat key→bytes store).
+
+``MemoryBackend`` keeps a process-global store keyed by root (so a "restart" in
+tests — a fresh Runtime in the same process — sees the previous run's state, the
+in-process analogue of the reference's kill-the-subprocess recovery tests).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+
+
+class KVBackend:
+    def put(self, key: str, value: bytes) -> None:
+        raise NotImplementedError
+
+    def get(self, key: str) -> bytes | None:
+        raise NotImplementedError
+
+    def list_keys(self, prefix: str = "") -> list[str]:
+        raise NotImplementedError
+
+    def delete(self, key: str) -> None:
+        raise NotImplementedError
+
+
+class FileBackend(KVBackend):
+    """One file per key under a root directory ('/' in keys maps to subdirs)."""
+
+    def __init__(self, root: str):
+        self.root = root
+        os.makedirs(root, exist_ok=True)
+        self._lock = threading.Lock()
+
+    def _path(self, key: str) -> str:
+        path = os.path.join(self.root, key)
+        if os.path.commonpath([os.path.abspath(path), os.path.abspath(self.root)]) != os.path.abspath(self.root):
+            raise ValueError(f"key escapes backend root: {key!r}")
+        return path
+
+    def put(self, key: str, value: bytes) -> None:
+        path = self._path(key)
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        tmp = path + ".tmp"
+        with self._lock:
+            with open(tmp, "wb") as f:
+                f.write(value)
+            os.replace(tmp, path)  # atomic publish
+
+    def get(self, key: str) -> bytes | None:
+        try:
+            with open(self._path(key), "rb") as f:
+                return f.read()
+        except FileNotFoundError:
+            return None
+
+    def list_keys(self, prefix: str = "") -> list[str]:
+        out = []
+        for dirpath, _dirs, files in os.walk(self.root):
+            for fn in files:
+                if fn.endswith(".tmp"):
+                    continue
+                rel = os.path.relpath(os.path.join(dirpath, fn), self.root)
+                rel = rel.replace(os.sep, "/")
+                if rel.startswith(prefix):
+                    out.append(rel)
+        return sorted(out)
+
+    def delete(self, key: str) -> None:
+        try:
+            os.remove(self._path(key))
+        except FileNotFoundError:
+            pass
+
+
+_MEMORY_STORES: dict[str, dict[str, bytes]] = {}
+_MEMORY_LOCK = threading.Lock()
+
+
+class MemoryBackend(KVBackend):
+    def __init__(self, root: str = "default"):
+        with _MEMORY_LOCK:
+            self._store = _MEMORY_STORES.setdefault(root, {})
+
+    def put(self, key: str, value: bytes) -> None:
+        with _MEMORY_LOCK:
+            self._store[key] = value
+
+    def get(self, key: str) -> bytes | None:
+        with _MEMORY_LOCK:
+            return self._store.get(key)
+
+    def list_keys(self, prefix: str = "") -> list[str]:
+        with _MEMORY_LOCK:
+            return sorted(k for k in self._store if k.startswith(prefix))
+
+    def delete(self, key: str) -> None:
+        with _MEMORY_LOCK:
+            self._store.pop(key, None)
+
+    @staticmethod
+    def clear(root: str = "default") -> None:
+        with _MEMORY_LOCK:
+            _MEMORY_STORES.pop(root, None)
+
+
+class MockBackend(MemoryBackend):
+    """Records the operation log for assertions (reference mock backend)."""
+
+    def __init__(self, root: str = "mock"):
+        super().__init__(root)
+        self.operations: list[tuple[str, str]] = []
+
+    def put(self, key: str, value: bytes) -> None:
+        self.operations.append(("put", key))
+        super().put(key, value)
+
+    def get(self, key: str) -> bytes | None:
+        self.operations.append(("get", key))
+        return super().get(key)
+
+
+def backend_from_config(backend) -> KVBackend:
+    if backend.kind == "filesystem":
+        return FileBackend(backend.path)
+    if backend.kind == "memory":
+        return MemoryBackend(backend.path or "default")
+    if backend.kind == "mock":
+        return MockBackend(backend.path or "mock")
+    raise ValueError(f"unknown persistence backend kind {backend.kind!r}")

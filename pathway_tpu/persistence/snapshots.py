@@ -1,0 +1,160 @@
+"""Input snapshots + offsets + operator snapshots.
+
+Block-engine counterpart of the reference's persistence core (``src/persistence/``):
+
+- **Input snapshots** (``input_snapshot.rs:66,217``): every event a connector pushes
+  into a ``StreamInputNode`` is appended to a per-source chunked event log; on
+  restart the log replays into the node *before* live reading, and the stored
+  event-count offset tells the (deterministic) source how many leading events to
+  skip — the engine-level analogue of ``OffsetAntichain`` + ``seek``
+  (``src/connectors/mod.rs:100-105``). Sources are identified by a stable
+  persistent id: the logical node's user ``name`` or its graph position.
+- **Metadata** (``state.rs:17,35``): per-source committed offset + last logical
+  time, written on every flush; the restart point is what all sources have
+  committed (single-process: the minimum is trivial).
+Operator snapshots (``operator_snapshot.rs``) are not implemented yet — a partial
+restore of stateful nodes would be silently wrong, so ``operator_persisting``
+raises until every stateful node implements an explicit save/restore contract.
+Consistency level matches the reference's OSS tier: at-least-once on restart
+(SURVEY §5.3; exactly-once output dedup is enterprise there, future work here).
+"""
+
+from __future__ import annotations
+
+import pickle
+from typing import Any
+
+from pathway_tpu.engine import operators as ops
+from pathway_tpu.persistence.backends import KVBackend, backend_from_config
+
+_CHUNK = "chunk"
+_META = "metadata"
+
+
+class _PersistedInput:
+    """Wraps one StreamInputNode: logs pushes, skips re-read events on restart."""
+
+    def __init__(self, pid: str, node: ops.StreamInputNode, backend: KVBackend):
+        self.pid = pid
+        self.node = node
+        self.backend = backend
+        self.buffer: list[tuple[int, tuple | None, int]] = []
+        self.stored_offset = 0  # events already persisted (skip this many live)
+        self.seen_live = 0
+        self.n_chunks = 0
+        self._load_metadata()
+        self.persisted = self.stored_offset
+        self._install()
+
+    # -- storage ------------------------------------------------------------
+    def _key(self, name: str) -> str:
+        return f"inputs/{self.pid}/{name}"
+
+    def _load_metadata(self) -> None:
+        raw = self.backend.get(self._key(_META))
+        if raw is not None:
+            meta = pickle.loads(raw)
+            self.stored_offset = meta["offset"]
+            self.n_chunks = meta["chunks"]
+
+    def _flush_metadata(self) -> None:
+        self.backend.put(
+            self._key(_META),
+            pickle.dumps({"offset": self.persisted, "chunks": self.n_chunks}),
+        )
+
+    def replay(self) -> None:
+        """Push the stored event log into the node (before live reads start) —
+        through the ORIGINAL push so replay isn't counted as live traffic."""
+        for i in range(self.n_chunks):
+            raw = self.backend.get(self._key(f"{_CHUNK}_{i:08d}"))
+            if raw is None:
+                continue
+            for key, values, diff in pickle.loads(raw):
+                self._original_push(key, values, diff)
+
+    def flush(self) -> None:
+        if not self.buffer:
+            return
+        chunk, self.buffer = self.buffer, []
+        self.backend.put(
+            self._key(f"{_CHUNK}_{self.n_chunks:08d}"), pickle.dumps(chunk)
+        )
+        self.n_chunks += 1
+        self.persisted += len(chunk)
+        self._flush_metadata()
+
+    # -- node wrapping ------------------------------------------------------
+    def _install(self) -> None:
+        original_push = self.node.push
+        self._original_push = original_push
+        me = self
+
+        def push(key: int, values: tuple | None, diff: int = 1) -> None:
+            me.seen_live += 1
+            if me.seen_live <= me.stored_offset:
+                return  # already replayed from the snapshot; deterministic
+                # sources re-produce their prefix — drop it (offset seek)
+            me.buffer.append((key, values, diff))
+            original_push(key, values, diff)
+
+        def push_many(events) -> None:
+            for key, values, diff in events:
+                push(key, values, diff)
+
+        self.node.push = push  # type: ignore[method-assign]
+        self.node.push_many = push_many  # type: ignore[method-assign]
+
+
+class Persistence:
+    def __init__(self, config):
+        self.config = config
+        self.backend = backend_from_config(config.backend)
+        if config.persistence_mode == "operator_persisting":
+            raise NotImplementedError(
+                "operator_persisting is not implemented yet; use the default "
+                "input-snapshot mode (persistence_mode='persisting')"
+            )
+        self.inputs: list[_PersistedInput] = []
+
+    # called by Runtime once the engine graph is built, before drivers start
+    def on_graph_built(self, ctx) -> None:
+        # pid stability: a source keeps its snapshots across unrelated pipeline
+        # edits — use the connector's name alone when unique among sources, and
+        # only disambiguate same-named sources by their order among sources
+        sources = [
+            (lnode, node)
+            for lnode, node in ctx.build_order
+            if isinstance(node, ops.StreamInputNode)
+        ]
+        name_counts: dict[str, int] = {}
+        for lnode, _ in sources:
+            name_counts[lnode.name] = name_counts.get(lnode.name, 0) + 1
+        seen: dict[str, int] = {}
+        for lnode, node in sources:
+            if name_counts[lnode.name] == 1:
+                pid = lnode.name
+            else:
+                i = seen.get(lnode.name, 0)
+                seen[lnode.name] = i + 1
+                pid = f"{lnode.name}-{i}"
+            self.inputs.append(_PersistedInput(pid, node, self.backend))
+        for p in self.inputs:
+            p.replay()
+
+    def on_tick_done(self, time: int) -> None:
+        for p in self.inputs:
+            p.flush()
+
+    def on_close(self) -> None:
+        self.on_tick_done(-1)
+
+
+def attach(runtime, config) -> None:
+    runtime.persistence = Persistence(config)
+    if config.backend.kind == "filesystem" and config.backend.path:
+        # colocate UDF DiskCache with the persistent storage (reference:
+        # UdfCaching rides the same machinery, internals/udfs/caches.py:35)
+        import os
+
+        os.environ.setdefault("PATHWAY_PERSISTENT_STORAGE", config.backend.path)

@@ -82,10 +82,10 @@ class VectorBackend(IndexBackend):
         for hits, k, flt in zip(raw, ks, filters):
             picked = []
             for key, score in hits:
+                if len(picked) >= k:
+                    break
                 if flt(self.metadata.get(key)):
                     picked.append((key, float(score)))
-                if len(picked) == k:
-                    break
             out.append(picked)
         return out
 
@@ -179,7 +179,17 @@ class ExternalIndexNode(Node):
     def _filter(self, expr):
         if expr not in self._filter_cache:
             try:
-                self._filter_cache[expr] = compile_filter(expr)
+                compiled = compile_filter(expr)
+
+                def safe(md, _f=compiled):
+                    # evaluation errors (type mismatches against this doc's
+                    # metadata) exclude the doc, never kill the dataflow
+                    try:
+                        return bool(_f(md))
+                    except Exception:
+                        return False
+
+                self._filter_cache[expr] = safe
             except Exception:
                 # a malformed user-supplied filter poisons only its own query
                 # (empty reply), never the dataflow — one bad HTTP request must
@@ -206,12 +216,16 @@ class ExternalIndexNode(Node):
         docs, queries = inputs
         docs_changed = False
         if docs is not None:
+            # removals first: consolidation may reorder a same-key (-1, +1)
+            # upsert pair arbitrarily, and remove() is keyed by key alone — an
+            # add-then-remove ordering would silently drop the updated doc
             for i in range(len(docs)):
-                key = int(docs.keys[i])
+                if docs.diffs[i] < 0:
+                    self.backend.remove(int(docs.keys[i]))
+            for i in range(len(docs)):
                 if docs.diffs[i] > 0:
+                    key = int(docs.keys[i])
                     self.backend.add(key, docs.data["__item"][i], docs.data["__meta"][i])
-                else:
-                    self.backend.remove(key)
             docs_changed = len(docs) > 0
 
         out_keys: list[int] = []
@@ -225,9 +239,16 @@ class ExternalIndexNode(Node):
 
         new_queries: list[int] = []
         if queries is not None:
+            for i in range(len(queries)):  # removals first (see docs loop)
+                if queries.diffs[i] < 0:
+                    k = int(queries.keys[i])
+                    self._live_queries.pop(k, None)
+                    old = self._emitted.pop(k, None)
+                    if old is not None:
+                        emit(k, old, -1)
             for i in range(len(queries)):
-                k = int(queries.keys[i])
                 if queries.diffs[i] > 0:
+                    k = int(queries.keys[i])
                     self._live_queries[k] = (
                         queries.data["__item"][i],
                         int(queries.data["__k"][i]),
@@ -236,11 +257,6 @@ class ExternalIndexNode(Node):
                         else None,
                     )
                     new_queries.append(k)
-                else:
-                    self._live_queries.pop(k, None)
-                    old = self._emitted.pop(k, None)
-                    if old is not None:
-                        emit(k, old, -1)
 
         if self.as_of_now:
             to_answer = new_queries

@@ -191,16 +191,13 @@ class DataIndex:
             __score=pw.apply_with_type(lambda r: float(r[1]), dt.FLOAT, pw.this.reply),
         )
         data_cols = self.data_table.column_names()
-        matched = flat.with_columns(
-            **{n: self.data_table.ix(flat["__doc"], optional=True)[n] for n in data_cols}
-        )
+        ixed = self.data_table.ix(flat["__doc"], optional=True)  # one shared join
+        matched = flat.with_columns(**{n: ixed[n] for n in data_cols})
         if not collapse_rows:
             # flat mode: one row per match; pull query columns onto the match rows
+            q_ixed = qtable.ix(matched["__qid"])
             with_q = matched.with_columns(
-                **{
-                    f"__q_{n}": qtable.ix(matched["__qid"])[n]
-                    for n in qtable.column_names()
-                },
+                **{f"__q_{n}": q_ixed[n] for n in qtable.column_names()},
                 **{_SCORE: matched["__score"]},
             )
             return _DataIndexResult(

@@ -16,6 +16,7 @@ from typing import Any
 import numpy as np
 
 from pathway_tpu.internals.udfs import UDF, AsyncExecutor
+from pathway_tpu.xpacks.llm._utils import require
 
 
 class BaseEmbedder(UDF):
@@ -81,21 +82,13 @@ class JaxEmbedder(SentenceTransformerEmbedder):
     compatibility alias matching the reference API)."""
 
 
-def _require(module: str, cls: str):
-    try:
-        return __import__(module)
-    except ImportError as e:
-        raise ImportError(
-            f"{cls} requires the `{module}` package, which is not available in "
-            f"this environment; use SentenceTransformerEmbedder (TPU-native) instead"
-        ) from e
 
 
 class OpenAIEmbedder(BaseEmbedder):
     """Remote OpenAI embeddings (reference ``embedders.py:88``); async UDF."""
 
     def __init__(self, model: str = "text-embedding-3-small", capacity: int | None = None, **openai_kwargs):
-        _require("openai", "OpenAIEmbedder")
+        require("openai", "OpenAIEmbedder")
         import openai
 
         self.model = model
@@ -117,7 +110,7 @@ class OpenAIEmbedder(BaseEmbedder):
 
 class LiteLLMEmbedder(BaseEmbedder):
     def __init__(self, model: str, capacity: int | None = None, **kwargs):
-        _require("litellm", "LiteLLMEmbedder")
+        require("litellm", "LiteLLMEmbedder")
         import litellm
 
         async def embed(text: str) -> np.ndarray:
@@ -129,7 +122,7 @@ class LiteLLMEmbedder(BaseEmbedder):
 
 class GeminiEmbedder(BaseEmbedder):
     def __init__(self, model: str = "models/embedding-001", capacity: int | None = None, **kwargs):
-        _require("google.generativeai", "GeminiEmbedder")
+        require("google.generativeai", "GeminiEmbedder")
         import google.generativeai as genai
 
         async def embed(text: str) -> np.ndarray:

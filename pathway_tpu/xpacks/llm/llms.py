@@ -10,13 +10,9 @@ from __future__ import annotations
 from typing import Any
 
 from pathway_tpu.internals.udfs import UDF, AsyncExecutor
+from pathway_tpu.xpacks.llm._utils import require
 
 
-def _require(module: str, cls: str):
-    try:
-        return __import__(module)
-    except ImportError as e:
-        raise ImportError(f"{cls} requires the `{module}` package") from e
 
 
 class BaseChat(UDF):
@@ -33,7 +29,7 @@ def _as_messages(value: Any) -> list[dict]:
 
 class OpenAIChat(BaseChat):
     def __init__(self, model: str = "gpt-4o-mini", capacity: int | None = None, **openai_kwargs):
-        _require("openai", "OpenAIChat")
+        require("openai", "OpenAIChat")
         import openai
 
         client = openai.AsyncOpenAI(
@@ -53,7 +49,7 @@ class OpenAIChat(BaseChat):
 
 class LiteLLMChat(BaseChat):
     def __init__(self, model: str, capacity: int | None = None, **kwargs):
-        _require("litellm", "LiteLLMChat")
+        require("litellm", "LiteLLMChat")
         import litellm
 
         self.model = model
@@ -67,7 +63,7 @@ class LiteLLMChat(BaseChat):
 
 class CohereChat(BaseChat):
     def __init__(self, model: str = "command", capacity: int | None = None, **kwargs):
-        _require("cohere", "CohereChat")
+        require("cohere", "CohereChat")
         import cohere
 
         client = cohere.AsyncClient()
@@ -86,7 +82,7 @@ class HFPipelineChat(BaseChat):
     Runs on CPU torch in this image; prefer remote or mock chats in the hot path."""
 
     def __init__(self, model: str, device: str = "cpu", call_kwargs: dict | None = None, **pipeline_kwargs):
-        _require("transformers", "HFPipelineChat")
+        require("transformers", "HFPipelineChat")
         import transformers
 
         self.pipeline = transformers.pipeline(

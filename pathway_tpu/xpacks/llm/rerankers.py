@@ -75,9 +75,14 @@ class LLMReranker(UDF):
         import asyncio
         import re
 
+        from pathway_tpu.internals.udfs import AsyncExecutor
+
         self.llm = llm
-        chat = llm.func
+        # the wrapped callable keeps the chat's capacity/timeout/cache wrappers
+        chat = llm._callable()
         prompt_tmpl = self.PROMPT
+        if retry_strategy is not None and asyncio.iscoroutinefunction(chat):
+            chat = AsyncExecutor(retry_strategy=retry_strategy).wrap(chat)
 
         def parse_rating(answer) -> float:
             m = re.search(r"[1-5]", str(answer))

@@ -156,6 +156,64 @@ def test_data_index_flat_mode():
     assert list(rows_of(flat)) == [("kafka", "Kafka connector reads topics into tables.")]
 
 
+def test_index_doc_upsert_not_dropped():
+    """A same-tick (-1 old, +1 new) doc update must leave the NEW doc in the
+    index regardless of consolidation's row order (code-review regression)."""
+    import time as _time
+
+    class Subj(pw.io.python.ConnectorSubject):
+        def run(self):
+            self.next(data="original kafka doc")
+            _time.sleep(0.15)
+            self.next(data="updated kafka doc")
+
+        @property
+        def _session_type(self):
+            return "upsert"
+
+    class DocSchema(pw.Schema):
+        data: str = pw.column_definition(primary_key=True)
+
+    # direct node-level check: same-key remove+add in ONE batch, add sorted first
+    from pathway_tpu.engine.blocks import DeltaBatch
+    from pathway_tpu.stdlib.indexing._engine import BM25Backend, ExternalIndexNode
+
+    node = ExternalIndexNode(BM25Backend, as_of_now=False)
+    import numpy as np
+
+    docs = DeltaBatch.from_rows(
+        [7, 7],
+        [("new kafka text",), ("old kafka text",)],
+        ["__item"],
+        0,
+        diffs=[+1, -1],  # +1 physically before -1: the hazardous order
+    )
+    docs.data["__meta"] = np.array([None, None], dtype=object)
+    node.process([docs, None], 0)
+    assert 7 in node.backend.docs and node.backend.docs[7].get("new") == 1
+
+
+def test_vector_backend_k_zero():
+    import numpy as np
+
+    from pathway_tpu.stdlib.indexing._engine import VectorBackend
+
+    b = VectorBackend(dimension=4)
+    b.add(1, np.ones(4, np.float32), None)
+    assert b.search([np.ones(4, np.float32)], [0], [lambda m: True]) == [[]]
+
+
+def test_filter_runtime_error_excludes_doc_only():
+    store = DocumentStore(make_docs(), retriever_factory=TantivyBM25Factory())
+    # contains(path, 5) parses but raises per doc (int in str) — query must
+    # survive with an empty reply, not kill the run
+    queries = pw.debug.table_from_rows(
+        DocumentStore.RetrieveQuerySchema, [("kafka", 2, "contains(path, 5)", None)]
+    )
+    rows = [r[0].value if hasattr(r[0], "value") else r[0] for r in rows_of(store.retrieve_query(queries))]
+    assert rows == [[]]
+
+
 def test_batch_udf_row_isolation():
     """One bad row in a batched UDF must not error the whole block."""
     from pathway_tpu.internals.udfs import UDF
