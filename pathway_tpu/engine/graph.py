@@ -22,11 +22,27 @@ from pathway_tpu.engine.blocks import DeltaBatch, concat_batches
 END_OF_STREAM = np.iinfo(np.int64).max  # frontier value after all input closed
 
 
+SOLO = "solo"  # exchange marker: route every row to worker 0 (serial operator)
+
+
 class Node:
     """Engine operator. Subclasses implement ``process`` and optionally
-    ``on_frontier``."""
+    ``on_frontier``.
+
+    ``exchange_key(port)`` declares how a multi-worker runtime must partition
+    this node's input rows (the reference's exchange-by-shard contract,
+    ``src/engine/dataflow/shard.rs``): ``None`` = no co-location requirement
+    (stateless; process rows where they are produced), a callable
+    ``batch -> uint64[n]`` = co-locate rows by that key's shard, ``SOLO`` =
+    the operator is serial (global watermark / external index / output order) and
+    runs entirely on worker 0."""
 
     name: str = "node"
+
+    def exchange_key(self, port: int):
+        # stateful nodes keyed by row key need co-location by row key; stateless
+        # subclasses override with None, specially-keyed ones with their key fn
+        return lambda batch: batch.keys
 
     def __init__(self, n_inputs: int = 1):
         self.n_inputs = n_inputs

@@ -18,7 +18,7 @@ from typing import Any, Callable, Iterable
 import numpy as np
 
 from pathway_tpu.engine.blocks import DeltaBatch, consolidate, make_column
-from pathway_tpu.engine.graph import END_OF_STREAM, Node
+from pathway_tpu.engine.graph import END_OF_STREAM, SOLO, Node
 from pathway_tpu.engine.reducers_impl import ReducerImpl
 from pathway_tpu.internals.keys import combine_keys, row_keys, splitmix64
 
@@ -27,6 +27,9 @@ from pathway_tpu.internals.keys import combine_keys, row_keys, splitmix64
 
 class StaticInputNode(Node):
     name = "static_input"
+
+    def exchange_key(self, port):
+        return SOLO  # sources/sinks live on worker 0
 
     def __init__(self, batch_factory: Callable[[int], DeltaBatch]):
         super().__init__(n_inputs=0)
@@ -51,6 +54,9 @@ class StreamInputNode(Node):
     """
 
     name = "stream_input"
+
+    def exchange_key(self, port):
+        return SOLO  # sources/sinks live on worker 0
 
     def __init__(self, columns: list[str], np_dtypes: dict | None = None, upsert: bool = False):
         super().__init__(n_inputs=0)
@@ -114,6 +120,9 @@ class RowwiseNode(Node):
 
     name = "rowwise"
 
+    def exchange_key(self, port):
+        return None  # stateless: process where produced
+
     def __init__(self, program: Callable[[DeltaBatch], dict[str, np.ndarray]]):
         super().__init__(n_inputs=1)
         self.program = program
@@ -127,6 +136,9 @@ class RowwiseNode(Node):
 
 class FilterNode(Node):
     name = "filter"
+
+    def exchange_key(self, port):
+        return None  # stateless: process where produced
 
     def __init__(self, predicate: Callable[[DeltaBatch], np.ndarray]):
         super().__init__(n_inputs=1)
@@ -153,6 +165,9 @@ class ReindexNode(Node):
 
     name = "reindex"
 
+    def exchange_key(self, port):
+        return None  # stateless: process where produced
+
     def __init__(self, key_program: Callable[[DeltaBatch], np.ndarray]):
         super().__init__(n_inputs=1)
         self.key_program = key_program
@@ -166,6 +181,9 @@ class ReindexNode(Node):
 
 class SelectColumnsNode(Node):
     name = "select_columns"
+
+    def exchange_key(self, port):
+        return None  # stateless: process where produced
 
     def __init__(self, columns: list[str], rename: dict[str, str] | None = None):
         super().__init__(n_inputs=1)
@@ -185,6 +203,9 @@ class ConcatNode(Node):
     cannot collide (``concat_reindex``)."""
 
     name = "concat"
+
+    def exchange_key(self, port):
+        return None  # stateless: process where produced
 
     def __init__(self, n_inputs: int, columns: list[str], salts: list[int] | None = None):
         super().__init__(n_inputs=n_inputs)
@@ -210,6 +231,9 @@ class FlattenNode(Node):
     (reference: ``flatten_table``, ``src/engine/graph.rs``)."""
 
     name = "flatten"
+
+    def exchange_key(self, port):
+        return None  # stateless: process where produced
 
     def __init__(self, flatten_col: str, other_cols: list[str]):
         super().__init__(n_inputs=1)
@@ -273,6 +297,9 @@ class GroupByNode(Node):
     """
 
     name = "groupby"
+
+    def exchange_key(self, port):
+        return self._gkeys  # co-locate rows of one group
 
     def __init__(
         self,
@@ -509,6 +536,10 @@ class JoinNode(Node):
 
     name = "join"
 
+    def exchange_key(self, port):
+        col = self.left_on if port == 0 else self.right_on
+        return lambda batch, c=col: batch.data[c].astype(np.uint64)
+
     def __init__(
         self,
         left_cols: list[str],
@@ -646,6 +677,9 @@ class SubscribeNode(Node):
 
     name = "subscribe"
 
+    def exchange_key(self, port):
+        return SOLO  # sources/sinks live on worker 0
+
     def __init__(
         self,
         columns: list[str],
@@ -687,6 +721,9 @@ class CaptureNode(Node):
 
     name = "capture"
 
+    def exchange_key(self, port):
+        return SOLO  # sources/sinks live on worker 0
+
     def __init__(self, columns: list[str]):
         super().__init__(n_inputs=1)
         self.columns = columns
@@ -711,6 +748,9 @@ class CallbackOutputNode(Node):
     """Generic per-batch sink for io writers."""
 
     name = "output"
+
+    def exchange_key(self, port):
+        return SOLO  # sources/sinks live on worker 0
 
     def __init__(self, columns: list[str], on_batch: Callable, on_done: Callable | None = None):
         super().__init__(n_inputs=1)

@@ -29,6 +29,11 @@ from pathway_tpu.internals.logical import LogicalNode
 class SortNode(Node):
     name = "sort"
 
+    def exchange_key(self, port):
+        from pathway_tpu.engine.graph import SOLO
+
+        return SOLO  # global-watermark / ordered state: serial on worker 0
+
     def __init__(
         self,
         key_fn: Callable[[DeltaBatch], np.ndarray],

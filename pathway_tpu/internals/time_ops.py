@@ -39,6 +39,11 @@ class _WatermarkNode(Node):
     The watermark starts as ``None`` (no data seen) rather than ``-inf`` so time
     columns of any comparable dtype (ints, floats, datetime64) work."""
 
+    def exchange_key(self, port):
+        from pathway_tpu.engine.graph import SOLO
+
+        return SOLO  # global-watermark / ordered state: serial on worker 0
+
     def __init__(
         self,
         threshold_fn: Callable[[DeltaBatch], np.ndarray],
@@ -232,6 +237,11 @@ class FreezeNode(_WatermarkNode):
 
 class ForgetImmediatelyNode(Node):
     name = "forget_immediately"
+
+    def exchange_key(self, port):
+        from pathway_tpu.engine.graph import SOLO
+
+        return SOLO  # global-watermark / ordered state: serial on worker 0
 
     def __init__(self):
         super().__init__(n_inputs=1)

@@ -1,0 +1,30 @@
+"""Distribution: device meshes, multi-worker sharded execution, spawn CLI.
+
+The reference distributes by running the identical dataflow on every worker and
+exchanging records by key shard (``src/engine/dataflow/shard.rs``; timely's
+communication crate over shared memory/TCP, SURVEY §5.8). Here:
+
+- :mod:`pathway_tpu.parallel.mesh` — ``jax.sharding.Mesh`` construction and
+  ``jax.distributed`` initialization from ``PATHWAY_PROCESSES/PROCESS_ID`` env
+  (the coordinator replaces ``PATHWAY_FIRST_PORT`` TCP wiring).
+- :mod:`pathway_tpu.parallel.sharded` — the multi-worker engine runtime: every
+  worker builds the identical engine graph; each node declares its partitioning
+  contract (``Node.exchange_key``); blocks are split by key shard and routed to
+  the owning worker at exchange edges; ticks advance in lockstep (the global
+  frontier). Device compute inside nodes (einsums, jitted UDF batches) is where
+  the FLOPs live — workers parallelize the host-side state machinery.
+"""
+
+from pathway_tpu.parallel.mesh import (
+    device_mesh,
+    distributed_initialize,
+    shard_of_keys,
+)
+from pathway_tpu.parallel.sharded import ShardedRuntime
+
+__all__ = [
+    "ShardedRuntime",
+    "device_mesh",
+    "distributed_initialize",
+    "shard_of_keys",
+]

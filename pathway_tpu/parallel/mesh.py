@@ -1,0 +1,75 @@
+"""Mesh + distributed initialization helpers.
+
+Replaces the reference's process wiring (``PATHWAY_PROCESSES``/``PATHWAY_PROCESS_ID``
+/``PATHWAY_FIRST_PORT`` → timely ``CommunicationConfig::Cluster`` over TCP,
+``src/engine/dataflow/config.rs:63-120``) with the JAX-native equivalents: the
+``jax.distributed`` coordinator for multi-host process groups and
+``jax.sharding.Mesh`` over the visible device pool for on-device collectives.
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+from pathway_tpu.internals.keys import SHARD_MASK
+
+
+def shard_of_keys(keys: np.ndarray, n_shards: int) -> np.ndarray:
+    """Worker assignment for row keys: low shard bits modulo the worker count
+    (reference ``shard.rs:15-20``: shard = low 16 bits of the key)."""
+    return ((keys.astype(np.uint64) & SHARD_MASK) % np.uint64(n_shards)).astype(
+        np.int32
+    )
+
+
+def distributed_initialize(
+    coordinator_address: str | None = None,
+    num_processes: int | None = None,
+    process_id: int | None = None,
+) -> None:
+    """Initialize jax.distributed from args or PATHWAY_* env (no-op single-proc).
+
+    Env: ``PATHWAY_PROCESSES`` (world size), ``PATHWAY_PROCESS_ID`` (rank),
+    ``PATHWAY_COORDINATOR`` (host:port; default localhost:FIRST_PORT).
+    """
+    import jax
+
+    num_processes = num_processes or int(os.environ.get("PATHWAY_PROCESSES", "1"))
+    if num_processes <= 1:
+        return
+    process_id = (
+        process_id
+        if process_id is not None
+        else int(os.environ.get("PATHWAY_PROCESS_ID", "0"))
+    )
+    coordinator_address = coordinator_address or os.environ.get(
+        "PATHWAY_COORDINATOR",
+        f"127.0.0.1:{os.environ.get('PATHWAY_FIRST_PORT', '10100')}",
+    )
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+
+
+def device_mesh(axis_shapes: dict[str, int] | None = None, devices=None):
+    """Build a named Mesh over the (global) device pool.
+
+    Default: 1-D ``("data",)`` mesh over all devices. Pass e.g.
+    ``{"data": 4, "model": 2}`` for a 2-D dp×tp layout.
+    """
+    import jax
+    from jax.sharding import Mesh
+
+    devices = devices if devices is not None else jax.devices()
+    if not axis_shapes:
+        return Mesh(np.array(devices), ("data",))
+    names = tuple(axis_shapes.keys())
+    shape = tuple(axis_shapes.values())
+    n = int(np.prod(shape))
+    if n != len(devices):
+        raise ValueError(f"mesh shape {shape} needs {n} devices, have {len(devices)}")
+    return Mesh(np.array(devices).reshape(shape), names)

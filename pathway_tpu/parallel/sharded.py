@@ -1,0 +1,232 @@
+"""Multi-worker sharded execution of the engine graph.
+
+The reference's worker model (SURVEY §2.9, ``worker-architecture.md``): every
+worker builds the IDENTICAL dataflow; records are exchanged between workers by
+key shard before stateful operators; progress (the tick frontier) advances in
+lockstep. This module is the block-engine version:
+
+- ``ShardedRuntime(n_workers)`` builds one engine graph per worker from the same
+  logical outputs (node indices align across workers by construction).
+- At routing time, a consumer's :meth:`Node.exchange_key` decides placement:
+  ``None`` → stay on the producing worker (stateless op); a key function →
+  split the block by ``shard_of_keys`` and deliver each piece to its owner;
+  ``SOLO`` → everything to worker 0 (serial operators: sources, sinks,
+  global-watermark temporal ops, the external index).
+- Each tick runs sweep rounds: all workers sweep concurrently (threads), then
+  meet at a barrier; the tick ends when a round does no work anywhere. The
+  frontier phase runs the same way, so every worker passes timestamp t before
+  any sees t+1 — the global consistency frontier.
+
+Worker threads parallelize the host-side state machinery (hash joins, group
+state); the FLOP-heavy work inside nodes is already batched XLA. The same
+exchange contract carries to multi-process over ``jax.distributed`` (blocks
+serialized between processes instead of handed between threads).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any
+
+import numpy as np
+
+from pathway_tpu.engine.blocks import DeltaBatch
+from pathway_tpu.engine.graph import END_OF_STREAM, SOLO, EngineGraph, Node
+from pathway_tpu.internals.logical import BuildContext, LogicalNode
+from pathway_tpu.parallel.mesh import shard_of_keys
+
+
+class _Worker:
+    def __init__(self, index: int, graph: EngineGraph):
+        self.index = index
+        self.graph = graph
+        self.lock = threading.Lock()  # guards cross-worker accepts
+
+
+class ShardedRuntime:
+    """Drives W aligned engine graphs tick by tick with key-shard exchange.
+
+    API-compatible with ``engine.runtime.Runtime`` where the single-worker
+    code paths touch it (connectors, persistence hooks are worker-0 concerns).
+    """
+
+    def __init__(
+        self,
+        n_workers: int = 2,
+        monitoring_level: Any = None,
+        autocommit_duration_ms: int | None = 20,
+    ):
+        if n_workers < 1:
+            raise ValueError("need at least one worker")
+        self.n_workers = n_workers
+        self.autocommit_duration_ms = autocommit_duration_ms
+        self.monitoring_level = monitoring_level
+        self.connectors: list[Any] = []
+        self.persistence: Any = None
+        self.workers: list[_Worker] = []
+        self._stop_requested = False
+        self.current_time = 0
+        self.on_tick_done: list[Any] = []
+
+    def register_connector(self, driver) -> None:
+        self.connectors.append(driver)
+
+    def request_stop(self) -> None:
+        self._stop_requested = True
+
+    # ---------------------------------------------------------------- build
+    def _build(self, outputs: list[LogicalNode]) -> None:
+        # peers build first, worker 0 LAST: node factories may capture the built
+        # node into shared holders (connector subjects, rest holders) — the last
+        # build must be the one whose sources actually receive events and poll
+        self.workers = [None] * self.n_workers  # type: ignore[list-item]
+        for w in list(range(1, self.n_workers)) + [0]:
+            ctx = BuildContext(runtime=self if w == 0 else None)
+            for out in outputs:
+                ctx.resolve(out)
+            if w == 0:
+                ctx.finish()
+                self._ctx0 = ctx
+            self.workers[w] = _Worker(w, ctx.graph)
+        sizes = {len(w.graph.nodes) for w in self.workers}
+        assert len(sizes) == 1, "worker graphs misaligned"
+
+    # ---------------------------------------------------------------- routing
+    def _route(self, worker: _Worker, producer: Node, batches: list[DeltaBatch]) -> bool:
+        routed = False
+        consumers = worker.graph.edges.get(producer.node_index, [])
+        for batch in batches:
+            if batch is None or batch.is_empty:
+                continue
+            producer.stats_rows_out += len(batch)
+            for ci, port in consumers:
+                consumer = worker.graph.nodes[ci]
+                key_fn = consumer.exchange_key(port)
+                if key_fn is None:
+                    consumer.accept(port, batch)
+                    routed = True
+                elif key_fn == SOLO:
+                    target = self.workers[0]
+                    dest = target.graph.nodes[ci]
+                    with target.lock:
+                        dest.accept(port, batch)
+                    routed = True
+                else:
+                    if self.n_workers == 1:
+                        consumer.accept(port, batch)
+                        routed = True
+                        continue
+                    shards = shard_of_keys(
+                        np.asarray(key_fn(batch), dtype=np.uint64), self.n_workers
+                    )
+                    for w_idx in np.unique(shards):
+                        piece = batch.take(np.flatnonzero(shards == w_idx))
+                        target = self.workers[int(w_idx)]
+                        dest = target.graph.nodes[ci]
+                        with target.lock:
+                            dest.accept(port, piece)
+                        routed = True
+        return routed
+
+    # ---------------------------------------------------------------- ticking
+    def _sweep_worker(self, worker: _Worker, time: int) -> bool:
+        any_work = False
+        for node in worker.graph.nodes:
+            with worker.lock:
+                if not node.has_pending():
+                    continue
+                inputs = node.drain()
+            node.stats_rows_in += sum(len(b) for b in inputs if b is not None)
+            out = node.process(inputs, time)
+            if self._route(worker, node, out):
+                any_work = True
+            any_work = any_work or any(b is not None for b in inputs)
+        return any_work
+
+    def _parallel(self, fn) -> list:
+        """Run fn(worker) on every worker concurrently; collect results."""
+        results = [None] * self.n_workers
+        if self.n_workers == 1:
+            results[0] = fn(self.workers[0])
+            return results
+        threads = []
+        for i, w in enumerate(self.workers):
+            def target(i=i, w=w):
+                results[i] = fn(w)
+
+            t = threading.Thread(target=target)
+            t.start()
+            threads.append(t)
+        for t in threads:
+            t.join()
+        return results
+
+    def run_tick(self, time: int) -> None:
+        self.current_time = time
+        # sources live on worker 0 only — peers' source copies never poll
+        # (polling them would duplicate every input row per worker)
+        w0 = self.workers[0]
+        for node in w0.graph.nodes:
+            self._route(w0, node, node.poll(time))
+        while any(self._parallel(lambda w: self._sweep_worker(w, time))):
+            pass
+        progressed = True
+        while progressed:
+            progressed = False
+            for w in self.workers:
+                for node in w.graph.nodes:
+                    if self._route(w, node, node.on_frontier(time)):
+                        progressed = True
+            if progressed:
+                while any(self._parallel(lambda w: self._sweep_worker(w, time))):
+                    pass
+        for cb in self.on_tick_done:
+            cb(time)
+
+    # ---------------------------------------------------------------- run loop
+    def run(self, outputs: list[LogicalNode]):
+        import time as _time
+
+        self._build(outputs)
+        if self.persistence is not None:
+            self.persistence.on_graph_built(self._ctx0)
+            self.on_tick_done.append(self.persistence.on_tick_done)
+        for driver in self.connectors:
+            driver.start()
+        if not self.connectors:
+            self.run_tick(0)
+            self.close()
+            return self
+        tick = 0
+        period = (self.autocommit_duration_ms or 20) / 1000.0
+        all_virtual = all(getattr(d, "virtual", False) for d in self.connectors)
+        try:
+            while not self._stop_requested:
+                t0 = _time.perf_counter()
+                self.run_tick(tick)
+                tick += 1
+                if all(d.is_finished() for d in self.connectors):
+                    self.run_tick(tick)
+                    break
+                if not all_virtual:
+                    elapsed = _time.perf_counter() - t0
+                    if elapsed < period:
+                        _time.sleep(period - elapsed)
+        finally:
+            for driver in self.connectors:
+                driver.stop()
+        self.close()
+        return self
+
+    def close(self) -> None:
+        self.run_tick(END_OF_STREAM)
+        for w in self.workers:
+            for node in w.graph.nodes:
+                node.on_end()
+        if self.persistence is not None:
+            self.persistence.on_close()
+
+    # Runtime API used by debug capture
+    @property
+    def scheduler(self):
+        return self

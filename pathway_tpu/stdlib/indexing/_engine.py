@@ -168,6 +168,11 @@ class ExternalIndexNode(Node):
 
     name = "external_index"
 
+    def exchange_key(self, port):
+        from pathway_tpu.engine.graph import SOLO
+
+        return SOLO  # global-watermark / ordered state: serial on worker 0
+
     def __init__(self, backend_factory: Callable[[], IndexBackend], as_of_now: bool):
         super().__init__(n_inputs=2)
         self.backend = backend_factory()

@@ -60,6 +60,9 @@ class TemporalJoinNode(Node):
 
     name = "temporal_join"
 
+    def exchange_key(self, port):
+        return lambda batch: batch.data["__jk__"].astype(np.uint64)
+
     def __init__(
         self,
         n_left_cols: int,
@@ -273,6 +276,11 @@ class AsofNowJoinNode(Node):
     """Append-only left (queries) joined against right state as of arrival."""
 
     name = "asof_now_join"
+
+    def exchange_key(self, port):
+        from pathway_tpu.engine.graph import SOLO
+
+        return SOLO  # global-watermark / ordered state: serial on worker 0
 
     def __init__(self, n_left_cols: int, n_right_cols: int, how: str):
         super().__init__(n_inputs=2)

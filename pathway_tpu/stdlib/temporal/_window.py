@@ -200,6 +200,11 @@ class SessionAssignNode(Node):
 
     name = "session_assign"
 
+    def exchange_key(self, port):
+        from pathway_tpu.engine.graph import SOLO
+
+        return SOLO  # global-watermark / ordered state: serial on worker 0
+
     def __init__(self, columns: list[str], predicate, max_gap):
         super().__init__(n_inputs=1)
         self.columns = columns  # input column names (incl. __t/__inst materialized)
