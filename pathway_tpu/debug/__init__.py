@@ -26,9 +26,9 @@ from pathway_tpu.internals.table import Table, table_from_static_data
 def _parse_value(tok: str) -> Any:
     if tok == "" or tok == "None":
         return None
-    if tok == "True":
+    if tok in ("True", "true"):
         return True
-    if tok == "False":
+    if tok in ("False", "false"):
         return False
     try:
         return int(tok)

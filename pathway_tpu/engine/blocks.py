@@ -185,9 +185,14 @@ def consolidate(batch: DeltaBatch) -> DeltaBatch:
     group_starts = np.flatnonzero(boundaries)
     sums = np.add.reduceat(batch.diffs[order], group_starts)
     keep = sums != 0
-    idx = order[group_starts[keep]]
-    out = batch.take(idx)
-    out.diffs = sums[keep].astype(np.int64)
+    kept_idx = order[group_starts[keep]]
+    kept_sums = sums[keep].astype(np.int64)
+    kept_keys = k[group_starts[keep]]
+    # canonical order: within a key, retractions precede insertions, so stateful
+    # consumers (capture/combine/join state) can apply rows in batch order
+    final = np.lexsort((kept_sums, kept_keys))
+    out = batch.take(kept_idx[final])
+    out.diffs = kept_sums[final]
     return out
 
 

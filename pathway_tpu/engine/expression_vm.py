@@ -264,6 +264,12 @@ def eval_expr(expr: ColumnExpression, ctx: EvalContext) -> np.ndarray:
         return call_method(expr.namespace, expr.name, args)
 
     if isinstance(expr, PointerExpression):
+        if not expr.args:
+            # zero-arg pointer = the global-reduce singleton row
+            # (``total.ix_ref(context=t)`` after ``t.reduce(...)``)
+            from pathway_tpu.engine.operators import GroupByNode
+
+            return np.full(n, GroupByNode.GLOBAL_KEY, dtype=np.uint64)
         cols = [np.asarray(eval_expr(a, ctx)) for a in expr.args]
         salt = 0 if expr.instance is None else hash(expr.instance) & 0xFFFF
         return row_keys(cols, n=n, salt=salt)

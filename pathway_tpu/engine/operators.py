@@ -320,9 +320,20 @@ class GroupByNode(Node):
 
     GLOBAL_KEY = 0x6A09E667F3BCC908  # single group for global reduce()
 
+    NONE_KEY = 0xBB67AE8584CAA73B  # groups rows whose id-expression is (transiently) None
+
     def _gkeys(self, batch: DeltaBatch) -> np.ndarray:
         if self.key_col is not None:
-            return batch.data[self.key_col].astype(np.uint64)
+            col = batch.data[self.key_col]
+            if col.dtype == object:
+                # tolerate None ids: mid-tick outer-join padding may flow through
+                # before the matching side arrives; corrections retract it later
+                return np.fromiter(
+                    (self.NONE_KEY if v is None else int(v) for v in col),
+                    dtype=np.uint64,
+                    count=len(col),
+                )
+            return col.astype(np.uint64)
         if not self.group_cols:
             return np.full(len(batch), self.GLOBAL_KEY, dtype=np.uint64)
         return row_keys([batch.data[c] for c in self.group_cols], n=len(batch))
@@ -384,7 +395,11 @@ class GroupByNode(Node):
                             or st["acc"][r]
                         )
                         self._seq += 1
-            # emit
+            # emit — except the None-id group: mid-tick join padding may put rows
+            # there transiently; if they persist, they are dropped from output
+            # (reference: error-keyed rows go to the error log, not results)
+            if gk == self.NONE_KEY:
+                continue
             old = st["emitted"]
             if st["n"] <= 0:
                 new = None

@@ -1,0 +1,3 @@
+from .impl import Dist, DistFromSource, Vertex, bellman_ford
+
+__all__ = ["Dist", "DistFromSource", "Vertex", "bellman_ford"]

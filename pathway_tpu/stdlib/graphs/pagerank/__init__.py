@@ -1,0 +1,3 @@
+from .impl import Result, pagerank
+
+__all__ = ["Result", "pagerank"]

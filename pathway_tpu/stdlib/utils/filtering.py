@@ -1,0 +1,30 @@
+"""Row-filtering helpers (reference: ``python/pathway/stdlib/utils/filtering.py``).
+
+``argmax_rows``/``argmin_rows`` keep, per group, the single row where ``what`` is
+extreme — implemented as an argmax/argmin reduce whose winning row id re-keys a
+restriction of the original table.
+"""
+
+from __future__ import annotations
+
+import pathway_tpu as pw
+
+
+def argmax_rows(table: pw.Table, *on: pw.ColumnReference, what) -> pw.Table:
+    winners = (
+        table.groupby(*on)
+        .reduce(argmax_id=pw.reducers.argmax(what))
+        .with_id(pw.this.argmax_id)
+        .promise_universe_is_subset_of(table)
+    )
+    return table.restrict(winners, strict=False)
+
+
+def argmin_rows(table: pw.Table, *on: pw.ColumnReference, what) -> pw.Table:
+    winners = (
+        table.groupby(*on)
+        .reduce(argmin_id=pw.reducers.argmin(what))
+        .with_id(pw.this.argmin_id)
+        .promise_universe_is_subset_of(table)
+    )
+    return table.restrict(winners, strict=False)
