@@ -99,7 +99,11 @@ class DeltaBatch:
         diffs: Iterable[int] | None = None,
         np_dtypes: Mapping[str, np.dtype] | None = None,
     ) -> "DeltaBatch":
-        keys_arr = np.fromiter((np.uint64(k) for k in keys), dtype=np.uint64)
+        keys_arr = (
+            keys.astype(np.uint64, copy=False)
+            if isinstance(keys, np.ndarray)
+            else np.fromiter(keys, dtype=np.uint64)
+        )
         n = len(keys_arr)
         rows = list(rows)
         data: dict[str, np.ndarray] = {}
@@ -114,6 +118,14 @@ class DeltaBatch:
         return DeltaBatch(keys_arr, diffs_arr, data, time)
 
 
+def column_to_list(arr: np.ndarray) -> list:
+    """Column → Python list for row-tuple assembly. datetime64/timedelta64 keep
+    their numpy scalar form (``tolist()`` would yield raw ns integers)."""
+    if arr.dtype.kind in ("M", "m"):
+        return list(arr)
+    return arr.tolist()
+
+
 def make_column(values: list, np_dtype: np.dtype) -> np.ndarray:
     """Build a column array of the schema's storage dtype, falling back to object
     when values don't fit (None in an int column, etc.)."""
@@ -121,6 +133,14 @@ def make_column(values: list, np_dtype: np.dtype) -> np.ndarray:
         arr = np.empty(len(values), dtype=object)
         arr[:] = values
         return arr
+    if np_dtype.kind != "b":
+        # direct conversion first: the common all-typed case needs no None scan
+        # (None raises TypeError and lands in the fallback below). bool is
+        # excluded: np.asarray silently coerces None to False
+        try:
+            return np.asarray(values, dtype=np_dtype)
+        except (TypeError, ValueError):
+            pass
     try:
         if any(v is None for v in values):
             if np_dtype.kind == "f":
@@ -131,10 +151,9 @@ def make_column(values: list, np_dtype: np.dtype) -> np.ndarray:
                 return np.asarray(
                     [np.datetime64("NaT") if v is None else v for v in values], dtype=np_dtype
                 )
-            arr = np.empty(len(values), dtype=object)
-            arr[:] = values
-            return arr
-        return np.asarray(values, dtype=np_dtype)
+        arr = np.empty(len(values), dtype=object)
+        arr[:] = values
+        return arr
     except (TypeError, ValueError):
         arr = np.empty(len(values), dtype=object)
         arr[:] = values
@@ -160,7 +179,11 @@ def concat_batches(batches: list[DeltaBatch]) -> DeltaBatch | None:
             merged = np.empty(len(keys), dtype=object)
             ofs = 0
             for c in cols:
-                merged[ofs : ofs + len(c)] = c
+                # list() keeps datetime64/timedelta64 scalars intact (direct
+                # slice-assign into an object array int-ifies them)
+                merged[ofs : ofs + len(c)] = (
+                    list(c) if c.dtype.kind in ("M", "m") else c
+                )
                 ofs += len(c)
             data[n] = merged
     return DeltaBatch(keys, diffs, data, time)

@@ -17,7 +17,7 @@ from typing import Any, Callable, Iterable
 
 import numpy as np
 
-from pathway_tpu.engine.blocks import DeltaBatch, consolidate, make_column
+from pathway_tpu.engine.blocks import DeltaBatch, column_to_list, consolidate, make_column
 from pathway_tpu.engine.graph import END_OF_STREAM, SOLO, Node
 from pathway_tpu.engine.reducers_impl import ReducerImpl
 from pathway_tpu.internals.keys import combine_keys, row_keys, splitmix64
@@ -317,6 +317,9 @@ class GroupByNode(Node):
         self.state: dict[int, dict] = {}
         self._seq = 0
         self.out_columns = list(self.out_group_cols) + [s[0] for s in self.reducer_specs]
+        # first-load fast path: per-group partials parked as arrays; folded into
+        # the dict state only if incremental deltas arrive later
+        self._archived: list[dict] = []
 
     GLOBAL_KEY = 0x6A09E667F3BCC908  # single group for global reduce()
 
@@ -338,10 +341,100 @@ class GroupByNode(Node):
             return np.full(len(batch), self.GLOBAL_KEY, dtype=np.uint64)
         return row_keys([batch.data[c] for c in self.group_cols], n=len(batch))
 
+    def _vector_first_load(self, batch: DeltaBatch, time: int) -> list[DeltaBatch] | None:
+        """All-new groups, semigroup-only reducers: aggregate with reduceat and
+        emit columns directly from arrays; park partials for lazy state build."""
+        gkeys = self._gkeys(batch)
+        order = np.argsort(gkeys, kind="stable")
+        gk_sorted = gkeys[order]
+        boundaries = np.empty(len(gk_sorted), dtype=bool)
+        boundaries[0] = True
+        boundaries[1:] = gk_sorted[1:] != gk_sorted[:-1]
+        starts = np.flatnonzero(boundaries)
+        diffs = batch.diffs
+        counts = np.add.reduceat(diffs[order], starts)
+        partials: list[Any] = []
+        for (_, impl, cols) in self.reducer_specs:
+            arrays = [batch.data[c] for c in cols]
+            p = impl.grouped_partials(arrays, diffs, order, starts)
+            if p is None:
+                return None  # column needs the per-group path
+            partials.append(p)
+        first_rows = order[starts]
+        gk_arr = gk_sorted[starts]
+        group_arrays = [batch.data[c][first_rows] for c in self.group_cols]
+
+        extracted: list[list] = []
+        for r, (_, impl, _) in enumerate(self.reducer_specs):
+            extracted.append([impl.extract(p) for p in partials[r]])
+
+        self._archived.append(
+            {
+                "gk": gk_arr.tolist(),
+                "gvals": [column_to_list(a) for a in group_arrays],
+                "counts": counts.tolist(),
+                "partials": partials,
+                "extracted": extracted,
+            }
+        )
+
+        emit_mask = (counts > 0) & (gk_arr != np.uint64(self.NONE_KEY))
+        idx = np.flatnonzero(emit_mask)
+        if not len(idx):
+            return []
+        data: dict[str, np.ndarray] = {}
+        for name, arr in zip(self.out_group_cols, group_arrays):
+            data[name] = arr[idx]
+        for r, (name, _, _) in enumerate(self.reducer_specs):
+            vals = [extracted[r][i] for i in idx]
+            probe = np.asarray(vals[:1]) if vals else None
+            npd = probe.dtype if probe is not None and probe.ndim == 1 and probe.dtype.kind in "iufb" else np.dtype(object)
+            data[name] = make_column(vals, npd)
+        return [
+            DeltaBatch(gk_arr[idx], np.ones(len(idx), dtype=np.int64), data, time)
+        ]
+
+    def _materialize_archived(self) -> None:
+        for arch in self._archived:
+            gks = arch["gk"]
+            gvals = arch["gvals"]
+            counts = arch["counts"]
+            partials = arch["partials"]
+            extracted = arch["extracted"]
+            for i in range(len(gks)):
+                gk = gks[i]
+                g_tuple = tuple(col[i] for col in gvals)
+                st = self.state.get(gk)
+                if st is None:
+                    st = {
+                        "g": g_tuple,
+                        "acc": [spec[1].make() for spec in self.reducer_specs],
+                        "n": 0,
+                        "emitted": None,
+                    }
+                    self.state[gk] = st
+                st["n"] += counts[i]
+                for r, spec in enumerate(self.reducer_specs):
+                    st["acc"][r] = spec[1].merge_partial(st["acc"][r], partials[r][i])
+                if st["n"] > 0 and gk != self.NONE_KEY:
+                    st["emitted"] = g_tuple[: len(self.out_group_cols)] + tuple(
+                        extracted[r][i] for r in range(len(self.reducer_specs))
+                    )
+                elif st["n"] <= 0:
+                    del self.state[gk]
+        self._archived = []
+
     def process(self, inputs, time):
         batch = inputs[0]
         if batch is None:
             return []
+        if not self.state and len(batch) and bool((batch.diffs > 0).all()):
+            if all(spec[1].semigroup for spec in self.reducer_specs) and not self._archived:
+                fast = self._vector_first_load(batch, time)
+                if fast is not None:
+                    return fast
+        if self._archived:
+            self._materialize_archived()
         gkeys = self._gkeys(batch)
         order = np.argsort(gkeys, kind="stable")
         gk_sorted = gkeys[order]
@@ -358,32 +451,53 @@ class GroupByNode(Node):
             [batch.data[c] for c in cols] for (_, _, cols) in self.reducer_specs
         ]
 
+        # one vectorized pass for group counts and semigroup partials; only
+        # multiset/stateful reducers fall back to per-row updates inside the loop
+        n_groups = len(starts)
+        group_counts = (
+            np.add.reduceat(diffs[order], starts).tolist() if n_groups else []
+        )
+        grouped: list[Any | None] = []
+        for spec, arrays in zip(self.reducer_specs, spec_arrays):
+            impl = spec[1]
+            if impl.semigroup and n_groups:
+                grouped.append(impl.grouped_partials(arrays, diffs, order, starts))
+            else:
+                grouped.append(None)
+        first_rows = order[starts] if n_groups else order
+        group_val_lists = [column_to_list(arr[first_rows]) for arr in group_arrays]
+        gk_list = gk_sorted[starts].tolist() if n_groups else []
+
         out_keys: list[int] = []
         out_diffs: list[int] = []
         out_rows: list[tuple] = []
 
-        for s, e in zip(starts, ends):
-            idx = order[s:e]
-            gk = int(gk_sorted[s])
+        for gi in range(n_groups):
+            s = starts[gi]
+            e = ends[gi]
+            gk = gk_list[gi]
             st = self.state.get(gk)
             if st is None:
                 st = {
-                    "g": tuple(arr[idx[0]] for arr in group_arrays),
+                    "g": tuple(col[gi] for col in group_val_lists),
                     "acc": [spec[1].make() for spec in self.reducer_specs],
                     "n": 0,
                     "emitted": None,
                 }
                 self.state[gk] = st
             # update accumulators
-            st["n"] += int(diffs[idx].sum())
+            st["n"] += int(group_counts[gi])
             for r, (spec, arrays) in enumerate(zip(self.reducer_specs, spec_arrays)):
                 impl = spec[1]
-                if impl.semigroup:
+                if grouped[r] is not None:
+                    st["acc"][r] = impl.merge_partial(st["acc"][r], grouped[r][gi])
+                elif impl.semigroup:
+                    idx = order[s:e]
                     cols_slice = [arr[idx] for arr in arrays]
                     partial = impl.batch_partial(cols_slice, diffs[idx], slice(None))
                     st["acc"][r] = impl.merge_partial(st["acc"][r], partial)
                 else:
-                    for i in idx:
+                    for i in order[s:e]:
                         st["acc"][r] = (
                             impl.update(
                                 st["acc"][r],
@@ -579,61 +693,225 @@ class JoinNode(Node):
         self.np_dtypes = np_dtypes or {}
         # jk -> {row_key -> values}
         self.state: list[dict[int, dict[int, tuple]]] = [defaultdict(dict), defaultdict(dict)]
+        # first-load fast path: batches joined vectorized and parked here; they
+        # are folded into the dict state only if incremental deltas arrive later
+        self._archived: list[list[DeltaBatch]] = [[], []]
+
+    # ---------------------------------------------------- vectorized first load
+
+    def _jk_valid(self, batch: DeltaBatch, side: int) -> tuple[np.ndarray, np.ndarray]:
+        col = batch.data[self.left_on if side == 0 else self.right_on]
+        if col.dtype == object:
+            n = len(col)
+            valid = np.fromiter((v is not None for v in col), dtype=bool, count=n)
+            jk = np.zeros(n, dtype=np.uint64)
+            nz = np.flatnonzero(valid)
+            if len(nz):
+                jk[nz] = np.fromiter((int(col[i]) for i in nz), dtype=np.uint64, count=len(nz))
+            return jk, valid
+        return col.astype(np.uint64), np.ones(len(col), dtype=bool)
+
+    def _side_cols(self, side: int) -> list[str]:
+        return self.left_cols if side == 0 else self.right_cols
+
+    def _out_col_names(self) -> tuple[str, str, list[str], list[str]]:
+        nl = len(self.left_cols)
+        return (
+            self.out_columns[0],
+            self.out_columns[1],
+            self.out_columns[2 : 2 + nl],
+            self.out_columns[2 + nl :],
+        )
+
+    def _pad_batch(self, batch: DeltaBatch, idx: np.ndarray, side: int, time: int) -> DeltaBatch:
+        """Null-padded output rows for unmatched rows ``idx`` of ``batch``."""
+        lid, rid, l_names, r_names = self._out_col_names()
+        keys_side = batch.keys[idx]
+        if side == 0:
+            out_keys = keys_side if self.left_id_only else splitmix64(keys_side ^ np.uint64(0xA0B0))
+        else:
+            out_keys = splitmix64(keys_side ^ np.uint64(0xB0A0))
+        none_col = np.full(len(idx), None, dtype=object)
+        data: dict[str, np.ndarray] = {}
+        data[lid] = keys_side if side == 0 else none_col
+        data[rid] = keys_side if side == 1 else none_col
+        my_names = l_names if side == 0 else r_names
+        other_names = r_names if side == 0 else l_names
+        for name, src in zip(my_names, self._side_cols(side)):
+            data[name] = batch.data[src][idx]
+        for name in other_names:
+            data[name] = none_col
+        return DeltaBatch(out_keys, batch.diffs[idx], data, time)
+
+    def _vector_first_load(
+        self, lb: DeltaBatch | None, rb: DeltaBatch | None, time: int
+    ) -> list[DeltaBatch]:
+        lid, rid, l_names, r_names = self._out_col_names()
+        out: list[DeltaBatch] = []
+        l_pad = self.how in ("left", "outer")
+        r_pad = self.how in ("right", "outer")
+
+        if lb is not None and rb is not None and len(lb) and len(rb):
+            l_jk, l_valid = self._jk_valid(lb, 0)
+            r_jk, r_valid = self._jk_valid(rb, 1)
+            lv = np.flatnonzero(l_valid)
+            rv = np.flatnonzero(r_valid)
+            r_order = rv[np.argsort(r_jk[rv], kind="stable")]
+            r_sorted = r_jk[r_order]
+            uniq, u_start, u_count = np.unique(r_sorted, return_index=True, return_counts=True)
+            if len(uniq):
+                pos = np.searchsorted(uniq, l_jk[lv]).clip(0, len(uniq) - 1)
+                has = uniq[pos] == l_jk[lv]
+            else:
+                pos = np.zeros(len(lv), dtype=np.int64)
+                has = np.zeros(len(lv), dtype=bool)
+            ml = lv[has]
+            cnt = u_count[pos[has]]
+            total = int(cnt.sum())
+            if total:
+                lexp = np.repeat(ml, cnt)
+                starts_ = u_start[pos[has]]
+                csum = np.cumsum(cnt) - cnt
+                ofs = np.repeat(starts_, cnt) + np.arange(total) - np.repeat(csum, cnt)
+                rexp = r_order[ofs]
+                lk = lb.keys[lexp]
+                rk = rb.keys[rexp]
+                out_keys = lk if self.left_id_only else combine_keys(lk, rk)
+                data: dict[str, np.ndarray] = {lid: lk, rid: rk}
+                for name, src in zip(l_names, self.left_cols):
+                    data[name] = lb.data[src][lexp]
+                for name, src in zip(r_names, self.right_cols):
+                    data[name] = rb.data[src][rexp]
+                out.append(
+                    DeltaBatch(out_keys, lb.diffs[lexp] * rb.diffs[rexp], data, time)
+                )
+            if l_pad:
+                lpad_idx = np.concatenate([lv[~has], np.flatnonzero(~l_valid)])
+                if len(lpad_idx):
+                    out.append(self._pad_batch(lb, lpad_idx, 0, time))
+            if r_pad:
+                uniq_l = np.unique(l_jk[lv])
+                if len(uniq_l):
+                    rpos = np.searchsorted(uniq_l, r_jk[rv]).clip(0, len(uniq_l) - 1)
+                    rhas = uniq_l[rpos] == r_jk[rv]
+                else:
+                    rhas = np.zeros(len(rv), dtype=bool)
+                rpad_idx = np.concatenate([rv[~rhas], np.flatnonzero(~r_valid)])
+                if len(rpad_idx):
+                    out.append(self._pad_batch(rb, rpad_idx, 1, time))
+        else:
+            single = lb if lb is not None and len(lb) else rb
+            side = 0 if single is lb else 1
+            if single is not None and len(single):
+                if (side == 0 and l_pad) or (side == 1 and r_pad):
+                    out.append(self._pad_batch(single, np.arange(len(single)), side, time))
+
+        for side, b in ((0, lb), (1, rb)):
+            if b is not None and len(b):
+                self._archived[side].append(b)
+        return out
+
+    def _materialize_archived(self) -> None:
+        """Fold parked first-load batches into the dict state so the per-row
+        incremental path sees them."""
+        for side, batches in enumerate(self._archived):
+            my_state = self.state[side]
+            for b in batches:
+                jk_arr, valid = self._jk_valid(b, side)
+                jks = jk_arr.tolist()
+                rks = b.keys.tolist()
+                val_lists = [column_to_list(b.data[c]) for c in self._side_cols(side)]
+                rows_l = list(zip(*val_lists)) if val_lists else [()] * len(b)
+                vmask = valid.tolist()
+                for i in range(len(rks)):
+                    if vmask[i]:
+                        my_state[jks[i]][rks[i]] = rows_l[i]
+        self._archived = [[], []]
 
     def _pad(self, side: int) -> tuple:
         """None-padding for the other side's columns."""
         n = len(self.right_cols) if side == 0 else len(self.left_cols)
         return tuple([None] * n)
 
-    def _out_key(self, lk: int | None, rk: int | None) -> int:
-        lk_ = np.asarray([0 if lk is None else lk], dtype=np.uint64)
-        rk_ = np.asarray([0 if rk is None else rk], dtype=np.uint64)
-        if self.left_id_only and lk is not None:
-            return int(lk)
-        if lk is None:
-            return int(splitmix64(rk_ ^ np.uint64(0xB0A0))[0])
-        if rk is None:
-            return int(splitmix64(lk_ ^ np.uint64(0xA0B0))[0])
-        return int(combine_keys(lk_, rk_)[0])
-
-    def _emit_matched(self, out, lk, lrow, rk, rrow, diff):
-        row = (lk, rk) + lrow + rrow
-        out.append((self._out_key(lk, rk), diff, row))
-
-    def _emit_left_pad(self, out, lk, lrow, diff):
-        row = (lk, None) + lrow + self._pad(0)
-        out.append((self._out_key(lk, None), diff, row))
-
-    def _emit_right_pad(self, out, rk, rrow, diff):
-        row = (None, rk) + self._pad(1) + rrow
-        out.append((self._out_key(None, rk), diff, row))
-
     def process(self, inputs, time):
-        out: list[tuple[int, int, tuple]] = []
+        # First load (no prior state, pure insertions): join the two batches
+        # vectorized — searchsorted matching, repeat-expansion of multi-matches —
+        # and park them; dict state is only built if incremental deltas follow.
+        lb, rb = inputs[0], inputs[1]
+        if not self.state[0] and not self.state[1] and not self._archived[0] and not self._archived[1]:
+            all_pos = all(
+                b is None or len(b) == 0 or bool((b.diffs > 0).all()) for b in (lb, rb)
+            )
+            if all_pos:
+                return self._vector_first_load(lb, rb, time)
+        if self._archived[0] or self._archived[1]:
+            self._materialize_archived()
+        # Emission is collected in three categories so output keys are computed
+        # in ONE vectorized pass at the end (combine_keys over arrays), instead
+        # of hashing 1-element arrays per matched pair.
+        m_lk: list[int] = []   # matched: left row key
+        m_rk: list[int] = []   # matched: right row key
+        m_diff: list[int] = []
+        m_row: list[tuple] = []
+        lp_k: list[int] = []   # left-padded (left row, no right match)
+        lp_diff: list[int] = []
+        lp_row: list[tuple] = []
+        rp_k: list[int] = []   # right-padded
+        rp_diff: list[int] = []
+        rp_row: list[tuple] = []
+
+        pad0 = self._pad(0)
+        pad1 = self._pad(1)
+
+        def emit_matched(lk, lrow, rk, rrow, diff):
+            m_lk.append(lk)
+            m_rk.append(rk)
+            m_diff.append(diff)
+            m_row.append((lk, rk) + lrow + rrow)
+
+        def emit_left_pad(lk, lrow, diff):
+            lp_k.append(lk)
+            lp_diff.append(diff)
+            lp_row.append((lk, None) + lrow + pad0)
+
+        def emit_right_pad(rk, rrow, diff):
+            rp_k.append(rk)
+            rp_diff.append(diff)
+            rp_row.append((None, rk) + pad1 + rrow)
+
         for side in (0, 1):
             batch = inputs[side]
             if batch is None:
                 continue
             my_state = self.state[side]
             other_state = self.state[1 - side]
-            on_col = batch.data[self.left_on if side == 0 else self.right_on]
-            val_cols = [
-                batch.data[c] for c in (self.left_cols if side == 0 else self.right_cols)
+            on_raw = batch.data[self.left_on if side == 0 else self.right_on]
+            # python-native lists: scalar access is far cheaper than numpy boxing
+            if on_raw.dtype == object:
+                jks = [None if v is None else int(v) for v in on_raw]
+            else:
+                jks = on_raw.astype(np.uint64).tolist()
+            rks = batch.keys.tolist()
+            diffs_l = batch.diffs.tolist()
+            val_lists = [
+                column_to_list(batch.data[c])
+                for c in (self.left_cols if side == 0 else self.right_cols)
             ]
+            rows_l = list(zip(*val_lists)) if val_lists else [()] * len(batch)
             pad_mine = self.how in ("left", "outer") if side == 0 else self.how in ("right", "outer")
             pad_other = self.how in ("right", "outer") if side == 0 else self.how in ("left", "outer")
-            for i in range(len(batch)):
-                jk = int(np.uint64(on_col[i])) if on_col[i] is not None else None
-                rk = int(batch.keys[i])
-                row = tuple(c[i] for c in val_cols)
-                diff = int(batch.diffs[i])
+            for i in range(len(rks)):
+                jk = jks[i]
+                rk = rks[i]
+                row = rows_l[i]
+                diff = diffs_l[i]
                 if jk is None:
                     # null join keys never match; padded if outer on my side
                     if pad_mine:
                         if side == 0:
-                            self._emit_left_pad(out, rk, row, diff)
+                            emit_left_pad(rk, row, diff)
                         else:
-                            self._emit_right_pad(out, rk, row, diff)
+                            emit_right_pad(rk, row, diff)
                     continue
                 mine = my_state[jk]
                 others = other_state[jk] if jk in other_state else {}
@@ -646,39 +924,58 @@ class JoinNode(Node):
                     if not mine:
                         del my_state[jk]
                 # matched outputs
-                for ok, orow in others.items():
+                if others:
                     if side == 0:
-                        self._emit_matched(out, rk, row, ok, orow, diff)
+                        for ok, orow in others.items():
+                            emit_matched(rk, row, ok, orow, diff)
                     else:
-                        self._emit_matched(out, ok, orow, rk, row, diff)
+                        for ok, orow in others.items():
+                            emit_matched(ok, orow, rk, row, diff)
                 # my padded row when no match on the other side
                 if pad_mine and n_other == 0:
                     if side == 0:
-                        self._emit_left_pad(out, rk, row, diff)
+                        emit_left_pad(rk, row, diff)
                     else:
-                        self._emit_right_pad(out, rk, row, diff)
+                        emit_right_pad(rk, row, diff)
                 # other side's padded rows flip when my count transitions 0<->+
                 if pad_other:
                     n_mine_after = n_mine_before + (1 if diff > 0 else -1)
                     if n_mine_before == 0 and n_mine_after == 1:
                         for ok, orow in others.items():
                             if side == 0:
-                                self._emit_right_pad(out, ok, orow, -1)
+                                emit_right_pad(ok, orow, -1)
                             else:
-                                self._emit_left_pad(out, ok, orow, -1)
+                                emit_left_pad(ok, orow, -1)
                     elif n_mine_before == 1 and n_mine_after == 0:
                         for ok, orow in others.items():
                             if side == 0:
-                                self._emit_right_pad(out, ok, orow, +1)
+                                emit_right_pad(ok, orow, +1)
                             else:
-                                self._emit_left_pad(out, ok, orow, +1)
-        if not out:
+                                emit_left_pad(ok, orow, +1)
+
+        n_out = len(m_lk) + len(lp_k) + len(rp_k)
+        if n_out == 0:
             return []
-        keys = [o[0] for o in out]
-        diffs = [o[1] for o in out]
-        rows = [o[2] for o in out]
+        key_parts: list[np.ndarray] = []
+        if m_lk:
+            lk_arr = np.array(m_lk, dtype=np.uint64)
+            if self.left_id_only:
+                key_parts.append(lk_arr)
+            else:
+                key_parts.append(combine_keys(lk_arr, np.array(m_rk, dtype=np.uint64)))
+        if lp_k:
+            lp_arr = np.array(lp_k, dtype=np.uint64)
+            if self.left_id_only:
+                key_parts.append(lp_arr)
+            else:
+                key_parts.append(splitmix64(lp_arr ^ np.uint64(0xA0B0)))
+        if rp_k:
+            key_parts.append(splitmix64(np.array(rp_k, dtype=np.uint64) ^ np.uint64(0xB0A0)))
+        keys = np.concatenate(key_parts)
+        diffs = np.array(m_diff + lp_diff + rp_diff, dtype=np.int64)
+        rows = m_row + lp_row + rp_row
         batch = DeltaBatch.from_rows(
-            keys, rows, self.out_columns, time, diffs=diffs, np_dtypes=self.np_dtypes
+            keys.tolist(), rows, self.out_columns, time, diffs=diffs, np_dtypes=self.np_dtypes
         )
         return [consolidate(batch)]
 

@@ -40,6 +40,18 @@ class ReducerImpl:
     def merge_partial(self, state: Any, partial: Any) -> Any:
         raise NotImplementedError
 
+    def grouped_partials(
+        self,
+        cols: list[np.ndarray],
+        diffs: np.ndarray,
+        order: np.ndarray,
+        starts: np.ndarray,
+    ) -> Any | None:
+        """All-groups partials in one vectorized pass (``order`` sorts rows by
+        group, ``starts`` marks group boundaries). Returns an indexable of one
+        partial per group, or None to fall back to per-group ``batch_partial``."""
+        return None
+
 
 class CountReducer(ReducerImpl):
     semigroup = True
@@ -58,6 +70,9 @@ class CountReducer(ReducerImpl):
 
     def merge_partial(self, state, partial):
         return state + partial
+
+    def grouped_partials(self, cols, diffs, order, starts):
+        return np.add.reduceat(diffs[order], starts).tolist()
 
 
 class SumReducer(ReducerImpl):
@@ -91,6 +106,13 @@ class SumReducer(ReducerImpl):
 
     def merge_partial(self, state, partial):
         return state + partial
+
+    def grouped_partials(self, cols, diffs, order, starts):
+        col = cols[0]
+        if col.dtype == object:
+            return None
+        weighted = col[order] * diffs[order]
+        return np.add.reduceat(weighted, starts).tolist()
 
 
 class ArraySumReducer(ReducerImpl):

@@ -94,19 +94,6 @@ class JoinResult:
             + [f"__r__{n}" for n in r_cols]
         )
         how = self.how
-        node = LogicalNode(
-            lambda: ops.JoinNode(
-                left_cols=[f"__v_{n}" for n in l_cols],
-                right_cols=[f"__v_{n}" for n in r_cols],
-                left_on="__jk__",
-                right_on="__jk__",
-                how=how,
-                out_columns=out_columns,
-                left_id_only=left_id_only,
-            ),
-            [pre_l._node, pre_r._node],
-            name=f"join_{how}",
-        )
         l_opt = how in ("right", "outer")
         r_opt = how in ("left", "outer")
         dtypes: dict[str, dt.DType] = {
@@ -119,6 +106,29 @@ class JoinResult:
         for n in r_cols:
             d = right._schema.dtypes()[n]
             dtypes[f"__r__{n}"] = dt.Optional(d) if r_opt else d
+        # storage dtypes keep join-output columns numeric where possible so
+        # downstream hashing/consolidation stays on vectorized paths; columns
+        # that can be None-padded stay object so None is preserved (a float64
+        # column would silently turn pad-None into NaN and break retraction
+        # matching against the fast path's object pads)
+        out_np_dtypes = {
+            c: (np.dtype(object) if isinstance(d, dt.Optional) else d.np_dtype)
+            for c, d in dtypes.items()
+        }
+        node = LogicalNode(
+            lambda: ops.JoinNode(
+                left_cols=[f"__v_{n}" for n in l_cols],
+                right_cols=[f"__v_{n}" for n in r_cols],
+                left_on="__jk__",
+                right_on="__jk__",
+                how=how,
+                out_columns=out_columns,
+                left_id_only=left_id_only,
+                np_dtypes=out_np_dtypes,
+            ),
+            [pre_l._node, pre_r._node],
+            name=f"join_{how}",
+        )
         uni = left._universe.subset() if left_id_only else Universe()
         self._joined = Table(node, schema_mod.schema_from_dtypes(dtypes), uni)
         return self._joined

@@ -76,26 +76,41 @@ def _canonical_bytes(v: Any) -> bytes:
     return b"\x0A" + repr(v).encode("utf-8")
 
 
+_U64_MASK = 0xFFFFFFFFFFFFFFFF
+
+
+def _splitmix64_int(x: int) -> int:
+    """Scalar splitmix64 over native Python ints — bit-identical to
+    :func:`splitmix64` but without numpy array/errstate overhead."""
+    x = (x + 0x9E3779B97F4A7C15) & _U64_MASK
+    x = ((x ^ (x >> 30)) * 0xBF58476D1CE4E5B9) & _U64_MASK
+    x = ((x ^ (x >> 27)) * 0x94D049BB133111EB) & _U64_MASK
+    return x ^ (x >> 31)
+
+
 def stable_hash_obj(v: Any) -> np.uint64:
     # Scalars that can also live in typed numpy columns MUST hash identically to
     # hash_column's vectorized paths — join/group keys may see the same value in
     # either storage (e.g. int64 column on one side, object column on the other).
     if isinstance(v, (bool, np.bool_, int, np.integer)):
-        return splitmix64(np.asarray([int(v) & 0xFFFFFFFFFFFFFFFF], dtype=np.uint64))[0]
+        return np.uint64(_splitmix64_int(int(v) & _U64_MASK))
     if isinstance(v, (float, np.floating)):
         f = np.float64(v) + 0.0  # normalize -0.0
-        return splitmix64(f.view(np.uint64).reshape(1))[0]
+        return np.uint64(_splitmix64_int(int(f.view(np.uint64))))
     if isinstance(v, np.datetime64):
-        ns = v.astype("datetime64[ns]").astype(np.int64)
-        return splitmix64(np.asarray([ns], dtype=np.uint64))[0]
+        ns = int(v.astype("datetime64[ns]").astype(np.int64))
+        return np.uint64(_splitmix64_int(ns & _U64_MASK))
     if isinstance(v, np.timedelta64):
-        ns = v.astype("timedelta64[ns]").astype(np.int64)
-        return splitmix64(np.asarray([ns], dtype=np.uint64))[0]
+        ns = int(v.astype("timedelta64[ns]").astype(np.int64))
+        return np.uint64(_splitmix64_int(ns & _U64_MASK))
     digest = hashlib.blake2b(_canonical_bytes(v), digest_size=8).digest()
     return np.uint64(int.from_bytes(digest, "little"))
 
 
 _hash_obj_ufunc = np.frompyfunc(stable_hash_obj, 1, 1)
+
+_INT_TYPES = (bool, np.bool_, int, np.int64, np.int32, np.intp)
+_FLOAT_TYPES = (float, np.float64, np.float32)
 
 
 def hash_column(col: np.ndarray) -> np.ndarray:
@@ -113,6 +128,18 @@ def hash_column(col: np.ndarray) -> np.ndarray:
         return splitmix64(col.astype("datetime64[ns]").astype(np.int64).astype(np.uint64))
     if kind == "m":
         return splitmix64(col.astype("timedelta64[ns]").astype(np.int64).astype(np.uint64))
+    if kind == "O" and len(col) > 16:
+        # homogeneous-scalar fast path: coerce to a typed array and take the
+        # vectorized branch (they hash identically by construction)
+        types = {type(v) for v in col}
+        try:
+            if types and all(issubclass(t, _INT_TYPES) for t in types):
+                return splitmix64(col.astype(np.int64).astype(np.uint64))
+            if types and all(issubclass(t, _FLOAT_TYPES) for t in types):
+                c = col.astype(np.float64) + 0.0
+                return splitmix64(c.view(np.uint64))
+        except (TypeError, ValueError, OverflowError):
+            pass
     return _hash_obj_ufunc(col).astype(np.uint64)
 
 

@@ -1,0 +1,142 @@
+"""Vectorized first-load fast paths must agree with the incremental per-row
+paths — static load followed by deltas exercises archive materialization in
+JoinNode/GroupByNode."""
+
+import numpy as np
+import pathway_tpu as pw
+from pathway_tpu.io.python import ConnectorSubject
+
+from tests.utils import assert_rows, rows_of
+
+
+class _Sub(ConnectorSubject):
+    def __init__(self, batches):
+        super().__init__()
+        self.batches = batches
+
+    def run(self):
+        import time as _t
+
+        for batch in self.batches:
+            for row in batch:
+                self.next(**row)
+            _t.sleep(0.05)
+
+
+class KV(pw.Schema):
+    k: int
+    v: int
+
+
+def test_join_groupby_first_load_then_deltas():
+    # static right side; streaming left side in two batches: the first batch
+    # takes the vectorized first-load path, the second forces materialization
+    right = pw.debug.table_from_rows(
+        pw.schema_from_types(k=int, w=int), [(1, 10), (2, 20), (3, 30)]
+    )
+    left_raw = pw.io.python.read(
+        _Sub([
+            [dict(k=1, v=1), dict(k=2, v=2), dict(k=9, v=9)],
+            [dict(k=1, v=5), dict(k=3, v=3)],
+        ]),
+        schema=KV,
+    )
+    j = left_raw.join(right, left_raw.k == right.k).select(
+        k=left_raw.k, v=left_raw.v, w=right.w
+    )
+    g = j.groupby(j.k).reduce(j.k, s=pw.reducers.sum(j.v * j.w))
+    assert_rows(g, [(1, 60), (2, 40), (3, 90)])
+
+
+def test_outer_join_first_load_then_retraction():
+    right = pw.debug.table_from_rows(
+        pw.schema_from_types(k=int, w=int), [(1, 10), (4, 40)]
+    )
+    left_raw = pw.io.python.read(
+        _Sub([
+            [dict(k=1, v=1), dict(k=2, v=2)],
+            [dict(k=4, v=4)],
+        ]),
+        schema=KV,
+    )
+    j = left_raw.join_outer(right, left_raw.k == right.k).select(
+        k=pw.coalesce(left_raw.k, right.k),
+        v=left_raw.v,
+        w=right.w,
+    )
+    assert_rows(j, [(1, 1, 10), (2, 2, None), (4, 4, 40)])
+
+
+def test_groupby_first_load_then_retraction_stream():
+    t = pw.debug.table_from_markdown(
+        """
+        k | v | __time__ | __diff__
+        1 | 3 | 2        | 1
+        1 | 4 | 2        | 1
+        2 | 5 | 2        | 1
+        1 | 3 | 4        | -1
+        """
+    )
+    g = t.groupby(t.k).reduce(t.k, s=pw.reducers.sum(t.v), c=pw.reducers.count())
+    assert_rows(g, [(1, 4, 1), (2, 5, 1)])
+
+
+class KT(pw.Schema):
+    k: int
+    ts: pw.DateTimeNaive
+
+
+def test_outer_join_float_pad_retraction_consistency():
+    """Pad-row None must cancel against its later retraction (not become NaN)."""
+    right = pw.debug.table_from_rows(
+        pw.schema_from_types(k=int, rx=float), [(1, 9.5)]
+    )
+    left_raw = pw.io.python.read(
+        _Sub([
+            [dict(k=3, v=1)],     # fast path: unmatched -> pad row rx=None
+            [dict(k=3, v=2)],     # second left row (still unmatched)
+        ]),
+        schema=KV,
+    )
+    j = left_raw.join_left(right, left_raw.k == right.k).select(
+        v=left_raw.v, rx=right.rx
+    )
+    g = j.groupby(j.rx).reduce(rx=j.rx, c=pw.reducers.count())
+    rows = sorted(rows_of(g).elements(), key=str)
+    assert rows == [(None, 2)], rows
+
+
+def test_groupby_datetime_group_values_stable_across_paths():
+    import numpy as np
+
+    d1 = np.datetime64("2024-01-01T00:00:00", "ns")
+    d2 = np.datetime64("2024-01-02T00:00:00", "ns")
+    t = pw.debug.table_from_markdown(
+        """
+        k | v | __time__ | __diff__
+        1 | 3 | 2        | 1
+        2 | 4 | 2        | 1
+        1 | 5 | 4        | 1
+        """
+    )
+    ts = t.select(ts=pw.if_else(t.k == 1, d1, d2), v=t.v)
+    g = ts.groupby(ts.ts).reduce(ts.ts, s=pw.reducers.sum(ts.v))
+    rows = sorted(rows_of(g).elements(), key=str)
+    assert rows == [(d1, 8), (d2, 4)], rows
+    # values must still be datetimes, not raw ns ints
+    assert all(isinstance(r[0], np.datetime64) for r in rows)
+
+
+def test_join_datetime_value_through_first_load():
+    import numpy as np
+
+    d1 = np.datetime64("2024-01-01T00:00:00", "ns")
+    left = pw.debug.table_from_rows(pw.schema_from_types(k=int, v=int), [(1, 7), (2, 8)])
+    right_rows = [(1, d1)]
+    right = pw.debug.table_from_rows(
+        pw.schema_from_types(k=int, ts=pw.DateTimeNaive), right_rows
+    )
+    j = left.join_left(right, left.k == right.k).select(v=left.v, ts=right.ts)
+    rows = sorted(rows_of(j).elements(), key=str)
+    assert rows == [(7, d1), (8, None)], rows
+    assert isinstance(rows[0][1], np.datetime64)

@@ -13,6 +13,8 @@ from pathway_tpu.debug import _capture
 
 
 def _norm(v: Any) -> Any:
+    if isinstance(v, (np.datetime64, np.timedelta64)):
+        return v  # .item() would yield raw ns integers
     if isinstance(v, np.generic):
         return v.item()
     if isinstance(v, tuple):
