@@ -251,7 +251,12 @@ def _capture(table: Table, **run_kwargs: Any) -> CapturedTable:
         return node
 
     lnode = LogicalNode(factory, [table._node], name="capture")
-    runtime = Runtime(autocommit_duration_ms=run_kwargs.pop("autocommit_duration_ms", 5))
+    from pathway_tpu.internals.run import make_runtime
+
+    runtime = make_runtime(
+        n_workers=run_kwargs.pop("n_workers", None),
+        autocommit_duration_ms=run_kwargs.pop("autocommit_duration_ms", 5),
+    )
     runtime.run([lnode])
     return CapturedTable(cols, holder["node"])
 

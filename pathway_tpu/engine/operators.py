@@ -667,7 +667,19 @@ class JoinNode(Node):
 
     def exchange_key(self, port):
         col = self.left_on if port == 0 else self.right_on
-        return lambda batch, c=col: batch.data[c].astype(np.uint64)
+
+        def key_fn(batch, c=col):
+            arr = batch.data[c]
+            if arr.dtype == object:
+                # null join keys never match; shard 0 handles their padding
+                return np.fromiter(
+                    (0 if v is None else int(v) for v in arr),
+                    dtype=np.uint64,
+                    count=len(arr),
+                )
+            return arr.astype(np.uint64)
+
+        return key_fn
 
     def __init__(
         self,

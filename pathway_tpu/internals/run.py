@@ -8,6 +8,7 @@ global graph, tree-shake, instantiate the engine dataflow, and drive it to compl
 
 from __future__ import annotations
 
+import os
 from typing import Any
 
 from pathway_tpu.engine.runtime import Runtime
@@ -24,6 +25,44 @@ class MonitoringLevel:
 _last_runtime: Runtime | None = None
 
 
+def resolved_n_workers(n_workers: int | None = None) -> int:
+    """kwarg beats env ``PATHWAY_THREADS`` beats 1 (reference: ``PathwayConfig``
+    threads resolution, ``internals/config.py``)."""
+    if n_workers is not None:
+        return max(1, int(n_workers))
+    return max(1, int(os.environ.get("PATHWAY_THREADS", "1")))
+
+
+def make_runtime(
+    *,
+    n_workers: int | None = None,
+    monitoring_level: Any = None,
+    autocommit_duration_ms: int | None = 20,
+):
+    """Runtime factory honoring the worker count (single-worker ``Runtime`` or
+    thread-sharded ``ShardedRuntime``)."""
+    if int(os.environ.get("PATHWAY_PROCESSES", "1")) > 1:
+        from pathway_tpu.parallel.cluster import ClusterRuntime
+
+        return ClusterRuntime(
+            monitoring_level=monitoring_level,
+            autocommit_duration_ms=autocommit_duration_ms,
+        )
+    w = resolved_n_workers(n_workers)
+    if w > 1:
+        from pathway_tpu.parallel.sharded import ShardedRuntime
+
+        return ShardedRuntime(
+            n_workers=w,
+            monitoring_level=monitoring_level,
+            autocommit_duration_ms=autocommit_duration_ms,
+        )
+    return Runtime(
+        monitoring_level=monitoring_level,
+        autocommit_duration_ms=autocommit_duration_ms,
+    )
+
+
 def run(
     *,
     monitoring_level: Any = MonitoringLevel.AUTO,
@@ -32,6 +71,7 @@ def run(
     persistence_config: Any = None,
     runtime_typechecking: bool | None = None,
     terminate_on_error: bool = True,
+    n_workers: int | None = None,
     **kwargs: Any,
 ) -> None:
     """Execute every output (sink/subscribe/debug) registered so far."""
@@ -41,7 +81,8 @@ def run(
 
         warnings.warn("pw.run(): no outputs registered; nothing to do")
         return
-    runtime = Runtime(
+    runtime = make_runtime(
+        n_workers=n_workers,
         monitoring_level=monitoring_level,
         autocommit_duration_ms=autocommit_duration_ms,
     )

@@ -1,0 +1,502 @@
+"""Multi-process cluster execution — cross-process block exchange.
+
+Role of the reference's timely ``CommunicationConfig::Cluster`` (intra-process
+channels + inter-process TCP with length-delimited frames,
+``external/timely-dataflow/communication/src/networking.rs``,
+``src/engine/dataflow/config.rs:63-120``): the global worker space is
+``threads × processes``; worker ``w`` lives on process ``w // threads``. Every
+process builds the identical dataflow for its local workers; a batch routed to a
+remote worker is serialized (length-prefixed pickle) to the owning process.
+
+Progress is coordinated, not gossiped: process 0 runs a tick coordinator. A tick
+is a sequence of rounds — each process sweeps its local workers to quiescence,
+reports ``(did_work, n_sent, n_received)``, and the coordinator declares the
+round set done when nobody worked and global sent == received (simple
+termination detection standing in for timely's distributed progress tracking —
+correct here because ticks are globally ordered and sends only happen inside
+rounds). The same barrier runs the frontier phase, so every process passes
+timestamp t before any sees t+1.
+
+On TPU pods this plane carries only control + relational blocks; FLOP-heavy
+tensors move separately over ICI via jax collectives (``ops/knn.py`` shard_map).
+The design keeps the two planes independent, like the reference keeps connector
+I/O threads out of the timely workers.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import socket
+import struct
+import threading
+import time as _time
+from typing import Any
+
+import numpy as np
+
+from pathway_tpu.engine.blocks import DeltaBatch
+from pathway_tpu.engine.graph import END_OF_STREAM, SOLO, Node
+from pathway_tpu.internals.logical import BuildContext, LogicalNode
+from pathway_tpu.parallel.mesh import shard_of_keys
+
+
+def cluster_env() -> tuple[int, int, int, int]:
+    """(threads, processes, process_id, first_port) from PATHWAY_* env."""
+    threads = max(1, int(os.environ.get("PATHWAY_THREADS", "1")))
+    processes = max(1, int(os.environ.get("PATHWAY_PROCESSES", "1")))
+    pid = int(os.environ.get("PATHWAY_PROCESS_ID", "0"))
+    first_port = int(os.environ.get("PATHWAY_FIRST_PORT", "21000"))
+    return threads, processes, pid, first_port
+
+
+def _send_msg(sock: socket.socket, obj: Any) -> None:
+    payload = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+    sock.sendall(struct.pack("<I", len(payload)) + payload)
+
+
+def _recv_msg(sock: socket.socket) -> Any:
+    header = _recv_exact(sock, 4)
+    if header is None:
+        return None
+    (n,) = struct.unpack("<I", header)
+    payload = _recv_exact(sock, n)
+    if payload is None:
+        return None
+    return pickle.loads(payload)
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes | None:
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            return None
+        buf += chunk
+    return buf
+
+
+class _PeerLinks:
+    """Pairwise TCP links between processes with a receiver thread per peer."""
+
+    def __init__(self, pid: int, n_proc: int, first_port: int, on_block, host: str = "127.0.0.1"):
+        self.pid = pid
+        self.n_proc = n_proc
+        self.first_port = first_port
+        self.host = host
+        self.on_block = on_block  # callback(worker, node_index, port, batch)
+        self.sent = 0
+        self.received = 0
+        self._lock = threading.Lock()
+        self._out: dict[int, socket.socket] = {}
+        self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._listener.bind((host, first_port + 1 + pid))
+        self._listener.listen(n_proc)
+        self._threads: list[threading.Thread] = []
+        self._accepting = threading.Thread(target=self._accept_loop, daemon=True)
+        self._accepting.start()
+        self._closed = False
+
+    def _accept_loop(self) -> None:
+        while not self._closed:
+            try:
+                conn, _ = self._listener.accept()
+            except OSError:
+                return
+            t = threading.Thread(target=self._recv_loop, args=(conn,), daemon=True)
+            t.start()
+            self._threads.append(t)
+
+    def _recv_loop(self, conn: socket.socket) -> None:
+        while True:
+            msg = _recv_msg(conn)
+            if msg is None:
+                return
+            kind, worker, node_index, port, payload = msg
+            assert kind == "block"
+            keys, diffs, data, t = payload
+            batch = DeltaBatch(keys, diffs, data, t)
+            self.on_block(worker, node_index, port, batch)
+            with self._lock:
+                self.received += 1
+
+    def _conn_to(self, peer: int) -> socket.socket:
+        sock = self._out.get(peer)
+        if sock is not None:
+            return sock
+        deadline = _time.time() + 30
+        while True:
+            try:
+                sock = socket.create_connection(
+                    (self.host, self.first_port + 1 + peer), timeout=5
+                )
+                break
+            except OSError:
+                if _time.time() > deadline:
+                    raise
+                _time.sleep(0.05)
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self._out[peer] = sock
+        return sock
+
+    def send_block(self, peer: int, worker: int, node_index: int, port: int, batch: DeltaBatch) -> None:
+        with self._lock:
+            sock = self._conn_to(peer)
+            _send_msg(
+                sock,
+                ("block", worker, node_index, port, (batch.keys, batch.diffs, batch.data, batch.time)),
+            )
+            self.sent += 1
+
+    def counters(self) -> tuple[int, int]:
+        with self._lock:
+            return self.sent, self.received
+
+    def close(self) -> None:
+        self._closed = True
+        try:
+            self._listener.close()
+        except OSError:
+            pass
+        for s in self._out.values():
+            try:
+                s.close()
+            except OSError:
+                pass
+
+
+class _Coordinator:
+    """Process 0's barrier service: collects per-round reports, answers
+    continue/advance/close decisions to every process (including itself)."""
+
+    def __init__(self, n_proc: int, first_port: int, host: str = "127.0.0.1"):
+        self.n_proc = n_proc
+        self._server = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._server.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._server.bind((host, first_port))
+        self._server.listen(n_proc)
+        self._conns: list[socket.socket] = []
+
+    def wait_connections(self) -> None:
+        while len(self._conns) < self.n_proc - 1:
+            conn, _ = self._server.accept()
+            self._conns.append(conn)
+
+    def barrier(self, my_report: Any, decide) -> Any:
+        """Collect one report from every peer + self, apply ``decide`` over the
+        list, broadcast and return the decision."""
+        reports = [my_report]
+        for conn in self._conns:
+            msg = _recv_msg(conn)
+            if msg is None:
+                raise RuntimeError("cluster peer disconnected")
+            reports.append(msg)
+        decision = decide(reports)
+        for conn in self._conns:
+            _send_msg(conn, decision)
+        return decision
+
+    def close(self) -> None:
+        for c in self._conns:
+            try:
+                c.close()
+            except OSError:
+                pass
+        try:
+            self._server.close()
+        except OSError:
+            pass
+
+
+class _CoordinatorClient:
+    def __init__(self, first_port: int, host: str = "127.0.0.1"):
+        deadline = _time.time() + 30
+        while True:
+            try:
+                self._sock = socket.create_connection((host, first_port), timeout=5)
+                break
+            except OSError:
+                if _time.time() > deadline:
+                    raise
+                _time.sleep(0.05)
+        self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+
+    def barrier(self, my_report: Any, decide=None) -> Any:
+        _send_msg(self._sock, my_report)
+        decision = _recv_msg(self._sock)
+        if decision is None:
+            raise RuntimeError("cluster coordinator disconnected")
+        return decision
+
+    def close(self) -> None:
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+
+class _LocalWorker:
+    def __init__(self, global_index: int, graph):
+        self.index = global_index
+        self.graph = graph
+        self.lock = threading.Lock()
+
+
+class ClusterRuntime:
+    """Sharded runtime spanning multiple processes.
+
+    Worker ``w``'s graph exists only on its owning process; routing resolves the
+    target worker by shard, then delivers locally or over the peer link. Every
+    process must execute the same program (same logical graph), like the
+    reference's per-worker ``logic`` closure.
+    """
+
+    def __init__(
+        self,
+        monitoring_level: Any = None,
+        autocommit_duration_ms: int | None = 20,
+    ):
+        threads, processes, pid, first_port = cluster_env()
+        self.threads = threads
+        self.n_proc = processes
+        self.pid = pid
+        self.first_port = first_port
+        self.n_workers = threads * processes
+        self.autocommit_duration_ms = autocommit_duration_ms
+        self.monitoring_level = monitoring_level
+        self.connectors: list[Any] = []
+        self.persistence: Any = None
+        self.on_tick_done: list[Any] = []
+        self._stop_requested = False
+        self.current_time = 0
+        self.local_workers: dict[int, _LocalWorker] = {}
+        self.links = _PeerLinks(pid, processes, first_port, self._on_remote_block)
+        if pid == 0:
+            self.coord = _Coordinator(processes, first_port)
+        else:
+            self.coord = None
+        self.client = None  # set in run()
+
+    # ------------------------------------------------------------------ build
+    def owner_of(self, worker: int) -> int:
+        return worker // self.threads
+
+    def register_connector(self, driver) -> None:
+        self.connectors.append(driver)
+
+    def request_stop(self) -> None:
+        self._stop_requested = True
+
+    def _build(self, outputs: list[LogicalNode]) -> None:
+        my_workers = range(self.pid * self.threads, (self.pid + 1) * self.threads)
+        # build in reverse so global worker 0 (on process 0) builds LAST — its
+        # nodes must own any shared holders (connector subjects, rest servers)
+        for w in sorted(my_workers, reverse=True):
+            ctx = BuildContext(runtime=self if w == 0 else None)
+            for out in outputs:
+                ctx.resolve(out)
+            if w == 0:
+                ctx.finish()
+                self._ctx0 = ctx
+            self.local_workers[w] = _LocalWorker(w, ctx.graph)
+
+    # ---------------------------------------------------------------- routing
+    def _on_remote_block(self, worker: int, node_index: int, port: int, batch: DeltaBatch) -> None:
+        lw = self.local_workers[worker]
+        with lw.lock:
+            lw.graph.nodes[node_index].accept(port, batch)
+
+    def _deliver(self, worker: int, node_index: int, port: int, batch: DeltaBatch) -> None:
+        owner = self.owner_of(worker)
+        if owner == self.pid:
+            lw = self.local_workers[worker]
+            with lw.lock:
+                lw.graph.nodes[node_index].accept(port, batch)
+        else:
+            self.links.send_block(owner, worker, node_index, port, batch)
+
+    def _route(self, lw: _LocalWorker, producer: Node, batches: list[DeltaBatch]) -> bool:
+        routed = False
+        consumers = lw.graph.edges.get(producer.node_index, [])
+        for batch in batches:
+            if batch is None or batch.is_empty:
+                continue
+            producer.stats_rows_out += len(batch)
+            for ci, port in consumers:
+                consumer = lw.graph.nodes[ci]
+                key_fn = consumer.exchange_key(port)
+                if key_fn is None:
+                    consumer.accept(port, batch)
+                elif key_fn == SOLO:
+                    self._deliver(0, ci, port, batch)
+                else:
+                    shards = shard_of_keys(
+                        np.asarray(key_fn(batch), dtype=np.uint64), self.n_workers
+                    )
+                    for w_idx in np.unique(shards):
+                        piece = batch.take(np.flatnonzero(shards == w_idx))
+                        self._deliver(int(w_idx), ci, port, piece)
+                routed = True
+        return routed
+
+    # ---------------------------------------------------------------- ticking
+    def _sweep_worker(self, lw: _LocalWorker, time: int) -> bool:
+        any_work = False
+        for node in lw.graph.nodes:
+            with lw.lock:
+                if not node.has_pending():
+                    continue
+                inputs = node.drain()
+            node.stats_rows_in += sum(len(b) for b in inputs if b is not None)
+            out = node.process(inputs, time)
+            self._route(lw, node, out)
+            any_work = True
+        return any_work
+
+    def _sweep_all_local(self, time: int) -> bool:
+        workers = list(self.local_workers.values())
+        if len(workers) == 1:
+            did = False
+            while self._sweep_worker(workers[0], time):
+                did = True
+            return did
+        did_any = False
+        while True:
+            results = [False] * len(workers)
+            threads = []
+            for i, lw in enumerate(workers):
+                def target(i=i, lw=lw):
+                    results[i] = self._sweep_worker(lw, time)
+
+                t = threading.Thread(target=target)
+                t.start()
+                threads.append(t)
+            for t in threads:
+                t.join()
+            if not any(results):
+                return did_any
+            did_any = True
+
+    def _barrier(self, report: Any, decide) -> Any:
+        if self.pid == 0:
+            return self.coord.barrier(report, decide)
+        return self.client.barrier(report)
+
+    def _round_until_quiescent(self, time: int, phase: str) -> None:
+        """Sweep-report rounds until globally quiescent (no work anywhere and
+        all in-flight messages delivered)."""
+        while True:
+            did = self._sweep_all_local(time)
+            sent, received = self.links.counters()
+            # pending is read AFTER the counters: a block that lands between
+            # sweep and here is visible either as sent>recv or as pending
+            pending = any(
+                node.has_pending()
+                for lw in self.local_workers.values()
+                for node in lw.graph.nodes
+            )
+            report = (phase, did or pending, sent, received)
+
+            def decide(reports):
+                any_work = any(r[1] for r in reports)
+                total_sent = sum(r[2] for r in reports)
+                total_recv = sum(r[3] for r in reports)
+                return {"again": any_work or total_sent != total_recv}
+
+            decision = self._barrier(report, decide)
+            if not decision["again"]:
+                return
+
+    def run_tick(self, time: int) -> None:
+        self.current_time = time
+        # sources poll on global worker 0 only
+        if 0 in self.local_workers:
+            lw0 = self.local_workers[0]
+            for node in lw0.graph.nodes:
+                self._route(lw0, node, node.poll(time))
+        self._round_until_quiescent(time, "sweep")
+        while True:
+            progressed = False
+            for lw in self.local_workers.values():
+                for node in lw.graph.nodes:
+                    if self._route(lw, node, node.on_frontier(time)):
+                        progressed = True
+
+            def decide(reports):
+                return {"again": any(r[1] for r in reports)}
+
+            decision = self._barrier(("frontier", progressed, 0, 0), decide)
+            if not decision["again"]:
+                break
+            self._round_until_quiescent(time, "sweep")
+        for cb in self.on_tick_done:
+            cb(time)
+
+    # ---------------------------------------------------------------- run loop
+    def run(self, outputs: list[LogicalNode]):
+        self._build(outputs)
+        if self.pid == 0:
+            self.coord.wait_connections()
+        else:
+            self.client = _CoordinatorClient(self.first_port)
+        if self.persistence is not None and self.pid == 0:
+            self.persistence.on_graph_built(self._ctx0)
+            self.on_tick_done.append(self.persistence.on_tick_done)
+        if self.pid == 0:
+            for driver in self.connectors:
+                driver.start()
+
+        period = (self.autocommit_duration_ms or 20) / 1000.0
+        tick = 0
+        try:
+            while True:
+                t0 = _time.perf_counter()
+                self.run_tick(tick)
+                tick += 1
+                # process 0 decides continuation (it owns the sources)
+                if self.pid == 0:
+                    done = (
+                        self._stop_requested
+                        or not self.connectors
+                        or all(d.is_finished() for d in self.connectors)
+                    )
+                    all_virtual = not self.connectors or all(
+                        getattr(d, "virtual", False) for d in self.connectors
+                    )
+                    decision = self.coord.barrier(
+                        ("cont", done, 0, 0), lambda reports: {"done": done}
+                    )
+                else:
+                    decision = self.client.barrier(("cont", False, 0, 0))
+                    all_virtual = True
+                if decision["done"]:
+                    self.run_tick(tick)  # drain final events
+                    break
+                if self.pid == 0 and self.connectors and not all_virtual:
+                    elapsed = _time.perf_counter() - t0
+                    if elapsed < period:
+                        _time.sleep(period - elapsed)
+        finally:
+            if self.pid == 0:
+                for driver in self.connectors:
+                    driver.stop()
+        self.close()
+        return self
+
+    def close(self) -> None:
+        self.run_tick(END_OF_STREAM)
+        for lw in self.local_workers.values():
+            for node in lw.graph.nodes:
+                node.on_end()
+        if self.persistence is not None and self.pid == 0:
+            self.persistence.on_close()
+        if self.client is not None:
+            self.client.close()
+        if self.coord is not None:
+            self.coord.close()
+        self.links.close()
+
+    @property
+    def scheduler(self):
+        return self
