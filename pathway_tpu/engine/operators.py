@@ -17,7 +17,13 @@ from typing import Any, Callable, Iterable
 
 import numpy as np
 
-from pathway_tpu.engine.blocks import DeltaBatch, column_to_list, consolidate, make_column
+from pathway_tpu.engine.blocks import (
+    DeltaBatch,
+    column_to_list,
+    concat_batches,
+    consolidate,
+    make_column,
+)
 from pathway_tpu.engine.graph import END_OF_STREAM, SOLO, Node
 from pathway_tpu.engine.reducers_impl import ReducerImpl
 from pathway_tpu.internals.keys import combine_keys, row_keys, splitmix64
@@ -1081,13 +1087,30 @@ class CallbackOutputNode(Node):
         self.columns = columns
         self.on_batch = on_batch
         self.on_done = on_done
+        self._tick_buffer: list[DeltaBatch] = []
 
     def process(self, inputs, time):
+        # buffer within the tick; emission happens sorted at the frontier so the
+        # written order is independent of worker count / block arrival order
         batch = inputs[0]
-        if batch is not None:
-            self.on_batch(batch, self.columns)
+        if batch is not None and not batch.is_empty:
+            self._tick_buffer.append(batch)
+        return []
+
+    def on_frontier(self, time):
+        if self._tick_buffer:
+            merged = concat_batches(self._tick_buffer)
+            self._tick_buffer = []
+            if merged is not None and not merged.is_empty:
+                # net out same-tick churn (mid-tick corrections differ by worker
+                # topology); consolidate returns canonical (key, diff) order, so
+                # output is byte-identical for any thread/process layout
+                merged = consolidate(merged)
+            if merged is not None and not merged.is_empty:
+                self.on_batch(merged, self.columns)
         return []
 
     def on_end(self):
+        self.on_frontier(END_OF_STREAM)
         if self.on_done is not None:
             self.on_done()

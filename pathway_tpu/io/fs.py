@@ -236,9 +236,12 @@ def write(table: Table, filename: str, *, format: str = "csv", **kwargs: Any) ->
         raise ValueError(f"unknown format {format!r}")
 
     def on_done() -> None:
+        # on_end fires once per worker replica of the sink node; only the first
+        # (worker 0, the SOLO owner of the handle) actually closes the file
         with lock:
-            fh.flush()
-            fh.close()
+            if not fh.closed:
+                fh.flush()
+                fh.close()
 
     LogicalNode(
         lambda: ops.CallbackOutputNode(cols, on_batch, on_done),

@@ -50,16 +50,21 @@ def cluster_env() -> tuple[int, int, int, int]:
     return threads, processes, pid, first_port
 
 
+def barrier_timeout() -> float:
+    """Seconds a barrier participant waits before declaring a peer dead."""
+    return float(os.environ.get("PATHWAY_BARRIER_TIMEOUT", "120"))
+
+
 def _send_msg(sock: socket.socket, obj: Any) -> None:
     payload = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
-    sock.sendall(struct.pack("<I", len(payload)) + payload)
+    sock.sendall(struct.pack("<Q", len(payload)) + payload)
 
 
 def _recv_msg(sock: socket.socket) -> Any:
-    header = _recv_exact(sock, 4)
+    header = _recv_exact(sock, 8)
     if header is None:
         return None
-    (n,) = struct.unpack("<I", header)
+    (n,) = struct.unpack("<Q", header)
     payload = _recv_exact(sock, n)
     if payload is None:
         return None
@@ -87,16 +92,23 @@ class _PeerLinks:
         self.on_block = on_block  # callback(worker, node_index, port, batch)
         self.sent = 0
         self.received = 0
-        self._lock = threading.Lock()
+        # counter lock is never held across socket I/O; each peer socket has its
+        # own send lock so a full TCP buffer on one link can't stall the others
+        # (or the receiver threads, which only need the counter lock)
+        self._counter_lock = threading.Lock()
+        self._conn_lock = threading.Lock()
         self._out: dict[int, socket.socket] = {}
+        self._send_locks: dict[int, threading.Lock] = {}
+        self.error: BaseException | None = None
+        self._closed = False
+        self._threads: list[threading.Thread] = []
         self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._listener.bind((host, first_port + 1 + pid))
         self._listener.listen(n_proc)
-        self._threads: list[threading.Thread] = []
+        # start the accept thread LAST: it reads instance attributes immediately
         self._accepting = threading.Thread(target=self._accept_loop, daemon=True)
         self._accepting.start()
-        self._closed = False
 
     def _accept_loop(self) -> None:
         while not self._closed:
@@ -109,22 +121,37 @@ class _PeerLinks:
             self._threads.append(t)
 
     def _recv_loop(self, conn: socket.socket) -> None:
-        while True:
-            msg = _recv_msg(conn)
-            if msg is None:
-                return
-            kind, worker, node_index, port, payload = msg
-            assert kind == "block"
-            keys, diffs, data, t = payload
-            batch = DeltaBatch(keys, diffs, data, t)
-            self.on_block(worker, node_index, port, batch)
-            with self._lock:
-                self.received += 1
+        try:
+            while True:
+                msg = _recv_msg(conn)
+                if msg is None:
+                    return
+                kind, worker, node_index, port, payload = msg
+                if kind != "block":
+                    raise RuntimeError(f"unexpected cluster message kind {kind!r}")
+                keys, diffs, data, t = payload
+                batch = DeltaBatch(keys, diffs, data, t)
+                self.on_block(worker, node_index, port, batch)
+                with self._counter_lock:
+                    self.received += 1
+        except BaseException as exc:  # surface to the main loop; don't die silently
+            if not self._closed:
+                self.error = exc
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
 
-    def _conn_to(self, peer: int) -> socket.socket:
-        sock = self._out.get(peer)
-        if sock is not None:
-            return sock
+    def check_error(self) -> None:
+        if self.error is not None:
+            raise RuntimeError("cluster peer link failed") from self.error
+
+    def _conn_to(self, peer: int) -> tuple[socket.socket, threading.Lock]:
+        with self._conn_lock:
+            sock = self._out.get(peer)
+            if sock is not None:
+                return sock, self._send_locks[peer]
         deadline = _time.time() + 30
         while True:
             try:
@@ -137,20 +164,29 @@ class _PeerLinks:
                     raise
                 _time.sleep(0.05)
         sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-        self._out[peer] = sock
-        return sock
+        with self._conn_lock:
+            if peer in self._out:  # lost the race; use the winner's socket
+                try:
+                    sock.close()
+                except OSError:
+                    pass
+                return self._out[peer], self._send_locks[peer]
+            self._out[peer] = sock
+            lock = self._send_locks[peer] = threading.Lock()
+        return sock, lock
 
     def send_block(self, peer: int, worker: int, node_index: int, port: int, batch: DeltaBatch) -> None:
-        with self._lock:
-            sock = self._conn_to(peer)
+        sock, lock = self._conn_to(peer)
+        with lock:
             _send_msg(
                 sock,
                 ("block", worker, node_index, port, (batch.keys, batch.diffs, batch.data, batch.time)),
             )
+        with self._counter_lock:
             self.sent += 1
 
     def counters(self) -> tuple[int, int]:
-        with self._lock:
+        with self._counter_lock:
             return self.sent, self.received
 
     def close(self) -> None:
@@ -179,16 +215,30 @@ class _Coordinator:
         self._conns: list[socket.socket] = []
 
     def wait_connections(self) -> None:
+        self._server.settimeout(barrier_timeout())
         while len(self._conns) < self.n_proc - 1:
-            conn, _ = self._server.accept()
+            try:
+                conn, _ = self._server.accept()
+            except socket.timeout:
+                raise RuntimeError(
+                    f"cluster startup timed out: {len(self._conns) + 1}/{self.n_proc} "
+                    "processes joined"
+                ) from None
             self._conns.append(conn)
 
     def barrier(self, my_report: Any, decide) -> Any:
         """Collect one report from every peer + self, apply ``decide`` over the
         list, broadcast and return the decision."""
         reports = [my_report]
+        timeout = barrier_timeout()
         for conn in self._conns:
-            msg = _recv_msg(conn)
+            conn.settimeout(timeout)
+            try:
+                msg = _recv_msg(conn)
+            except socket.timeout:
+                raise RuntimeError(
+                    f"cluster barrier timed out after {timeout}s waiting for a peer"
+                ) from None
             if msg is None:
                 raise RuntimeError("cluster peer disconnected")
             reports.append(msg)
@@ -224,7 +274,13 @@ class _CoordinatorClient:
 
     def barrier(self, my_report: Any, decide=None) -> Any:
         _send_msg(self._sock, my_report)
-        decision = _recv_msg(self._sock)
+        self._sock.settimeout(barrier_timeout())
+        try:
+            decision = _recv_msg(self._sock)
+        except socket.timeout:
+            raise RuntimeError(
+                "cluster barrier timed out waiting for the coordinator"
+            ) from None
         if decision is None:
             raise RuntimeError("cluster coordinator disconnected")
         return decision
@@ -387,6 +443,7 @@ class ClusterRuntime:
         """Sweep-report rounds until globally quiescent (no work anywhere and
         all in-flight messages delivered)."""
         while True:
+            self.links.check_error()
             did = self._sweep_all_local(time)
             sent, received = self.links.counters()
             # pending is read AFTER the counters: a block that lands between

@@ -1,0 +1,178 @@
+"""Multi-process cluster tests: N real processes on loopback must produce output
+byte-identical to a single-process run (reference pattern:
+``integration_tests/wordcount/conftest.py:1-17`` — processes on localhost TCP
+ports with a per-test port dispenser; ``cli.py:167`` spawn semantics)."""
+
+from __future__ import annotations
+
+import os
+import socket
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_PIPELINE = textwrap.dedent(
+    """
+    import sys
+
+    import pathway_tpu as pw
+
+    out = sys.argv[1]
+
+    t = pw.debug.table_from_markdown(
+        '''
+        k | v | s | __time__ | __diff__
+        1 | 3  | 10 | 2 | 1
+        2 | 4  | 20 | 2 | 1
+        3 | 7  | 30 | 2 | 1
+        1 | 5  | 40 | 4 | 1
+        2 | 9  | 15 | 4 | 1
+        1 | 3  | 10 | 6 | -1
+        4 | 11 | 25 | 6 | 1
+        2 | 4  | 20 | 8 | -1
+        '''
+    )
+    d = pw.debug.table_from_rows(
+        pw.schema_from_types(k=int, name=str),
+        [(i, f"g{i % 2}") for i in range(1, 5)],
+    )
+    j = t.join(d, t.k == d.k).select(name=d.name, v=t.v, s=t.s)
+    g = j.groupby(j.name).reduce(
+        j.name,
+        total=pw.reducers.sum(j.v),
+        c=pw.reducers.count(),
+        mx=pw.reducers.max(j.s),
+    )
+    w = j.windowby(
+        j.s, window=pw.temporal.tumbling(duration=15), instance=j.name
+    ).reduce(
+        name=pw.this._pw_instance,
+        start=pw.this._pw_window_start,
+        tot=pw.reducers.sum(pw.this.v),
+    )
+    pw.io.fs.write(g, out + ".groupby.csv", format="csv")
+    pw.io.fs.write(w, out + ".window.csv", format="csv")
+    pw.run()
+    """
+)
+
+
+def _free_port_base(n: int) -> int:
+    """Reserve a base port such that base..base+n are free right now."""
+    for base in range(23000, 60000, 101):
+        socks = []
+        try:
+            for p in range(base, base + n + 1):
+                s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+                s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+                s.bind(("127.0.0.1", p))
+                socks.append(s)
+            return base
+        except OSError:
+            continue
+        finally:
+            for s in socks:
+                s.close()
+    raise RuntimeError("no free port range found")
+
+
+def _run_cluster(script_path: str, out: str, *, processes: int, threads: int, timeout=120):
+    env = dict(os.environ)
+    env.update(
+        PATHWAY_PROCESSES=str(processes),
+        PATHWAY_THREADS=str(threads),
+        PATHWAY_BARRIER_TIMEOUT="45",
+        JAX_PLATFORMS="cpu",
+        PYTHONPATH=REPO,
+    )
+    if processes > 1:
+        env["PATHWAY_FIRST_PORT"] = str(_free_port_base(processes))
+    procs = []
+    for pid in range(processes):
+        penv = dict(env, PATHWAY_PROCESS_ID=str(pid))
+        procs.append(
+            subprocess.Popen(
+                [sys.executable, script_path, out],
+                env=penv,
+                stdout=subprocess.PIPE,
+                stderr=subprocess.STDOUT,
+                text=True,
+            )
+        )
+    outputs = []
+    for p in procs:
+        try:
+            stdout, _ = p.communicate(timeout=timeout)
+        except subprocess.TimeoutExpired:
+            texts = []
+            for q in procs:
+                q.kill()
+                out, _ = q.communicate()
+                texts.append(out or "")
+            raise AssertionError(
+                "cluster process hung; captured output:\n" + "\n---\n".join(texts)
+            )
+        outputs.append(stdout)
+    for p, txt in zip(procs, outputs):
+        assert p.returncode == 0, f"process exited {p.returncode}:\n{txt}"
+
+
+@pytest.fixture
+def pipeline_script(tmp_path):
+    path = tmp_path / "pipeline.py"
+    path.write_text(_PIPELINE)
+    return str(path)
+
+
+def _read(out: str, suffix: str) -> str:
+    with open(out + suffix) as fh:
+        return fh.read()
+
+
+def test_cluster_2proc_byte_identical(pipeline_script, tmp_path):
+    solo = str(tmp_path / "solo")
+    _run_cluster(pipeline_script, solo, processes=1, threads=1)
+    dist = str(tmp_path / "dist")
+    _run_cluster(pipeline_script, dist, processes=2, threads=1)
+    assert _read(solo, ".groupby.csv") == _read(dist, ".groupby.csv")
+    assert _read(solo, ".window.csv") == _read(dist, ".window.csv")
+
+
+def test_cluster_2x2_byte_identical(pipeline_script, tmp_path):
+    solo = str(tmp_path / "solo")
+    _run_cluster(pipeline_script, solo, processes=1, threads=1)
+    dist = str(tmp_path / "dist")
+    _run_cluster(pipeline_script, dist, processes=2, threads=2)
+    assert _read(solo, ".groupby.csv") == _read(dist, ".groupby.csv")
+    assert _read(solo, ".window.csv") == _read(dist, ".window.csv")
+
+
+def test_cluster_dead_peer_raises_not_hangs(pipeline_script, tmp_path):
+    """A missing peer must produce a timeout error, not an infinite hang."""
+    env = dict(os.environ)
+    env.update(
+        PATHWAY_PROCESSES="2",
+        PATHWAY_THREADS="1",
+        PATHWAY_PROCESS_ID="0",
+        PATHWAY_FIRST_PORT=str(_free_port_base(2)),
+        PATHWAY_BARRIER_TIMEOUT="3",
+        JAX_PLATFORMS="cpu",
+        PYTHONPATH=REPO,
+    )
+    p = subprocess.Popen(
+        [sys.executable, pipeline_script, str(tmp_path / "dead")],
+        env=env,
+        stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT,
+        text=True,
+    )
+    try:
+        stdout, _ = p.communicate(timeout=60)
+    except subprocess.TimeoutExpired:
+        p.kill()
+        raise AssertionError("process 0 hung forever on a dead peer")
+    assert p.returncode != 0
