@@ -1,0 +1,3 @@
+from pathway_tpu.cli import main
+
+main()

@@ -1,0 +1,125 @@
+"""``pathway_tpu`` command line — spawn / spawn-from-env / replay.
+
+Role of the reference CLI (``python/pathway/cli.py:53-113,167,253``): ``spawn``
+forks N processes of a user program with the ``PATHWAY_THREADS / PATHWAY_PROCESSES /
+PATHWAY_PROCESS_ID / PATHWAY_FIRST_PORT`` env contract consumed by
+``parallel/cluster.py``; ``replay`` re-runs a program against a recorded
+persistence log. Usage::
+
+    python -m pathway_tpu spawn --threads 2 --processes 2 python script.py
+    python -m pathway_tpu replay --record-path ./rec --mode speedrun python script.py
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import subprocess
+import sys
+
+import click
+
+from pathway_tpu.internals.config import get_pathway_config
+
+
+def _spawn_processes(env_base: dict[str, str], processes: int, args: tuple[str, ...]) -> int:
+    """Fork one subprocess per process id; forward SIGINT/SIGTERM; return the
+    first non-zero exit code (killing the rest), else 0."""
+    if not args:
+        raise click.UsageError("no program given (e.g. `spawn -t 2 python script.py`)")
+    procs: list[subprocess.Popen] = []
+    for pid in range(processes):
+        env = dict(env_base, PATHWAY_PROCESS_ID=str(pid))
+        procs.append(subprocess.Popen(list(args), env=env))
+
+    def forward(signum, frame):
+        for p in procs:
+            if p.poll() is None:
+                p.send_signal(signum)
+
+    old_int = signal.signal(signal.SIGINT, forward)
+    old_term = signal.signal(signal.SIGTERM, forward)
+    try:
+        code = 0
+        for p in procs:
+            rc = p.wait()
+            if rc != 0 and code == 0:
+                code = rc
+                for q in procs:
+                    if q.poll() is None:
+                        q.terminate()
+        return code
+    finally:
+        signal.signal(signal.SIGINT, old_int)
+        signal.signal(signal.SIGTERM, old_term)
+
+
+@click.group()
+def cli() -> None:
+    """pathway_tpu — TPU-native streaming dataflow framework."""
+
+
+@cli.command(context_settings={"ignore_unknown_options": True})
+@click.option("-t", "--threads", type=int, default=1, help="workers per process")
+@click.option("-n", "--processes", type=int, default=1, help="number of processes")
+@click.option("--first-port", type=int, default=None, help="base TCP port for the cluster plane")
+@click.option("--record", is_flag=True, default=False, help="record inputs for later replay")
+@click.option("--record-path", type=str, default="./record", help="where recorded inputs live")
+@click.argument("program", nargs=-1, type=click.UNPROCESSED)
+def spawn(threads, processes, first_port, record, record_path, program):
+    """Run PROGRAM across THREADS×PROCESSES workers on this host."""
+    env = dict(os.environ)
+    env["PATHWAY_THREADS"] = str(threads)
+    env["PATHWAY_PROCESSES"] = str(processes)
+    env["PATHWAY_FIRST_PORT"] = str(
+        first_port if first_port is not None else get_pathway_config().first_port
+    )
+    if record:
+        env["PATHWAY_PERSISTENT_STORAGE"] = record_path
+        env["PATHWAY_RECORD"] = "1"
+    sys.exit(_spawn_processes(env, processes, program))
+
+
+@cli.command(context_settings={"ignore_unknown_options": True})
+@click.argument("program", nargs=-1, type=click.UNPROCESSED)
+def spawn_from_env(program):
+    """Like spawn, but topology comes from the current PATHWAY_* environment."""
+    cfg = get_pathway_config()
+    env = cfg.spawn_env(0)
+    sys.exit(_spawn_processes(env, cfg.processes, program))
+
+
+@cli.command(context_settings={"ignore_unknown_options": True})
+@click.option("--record-path", type=str, default="./record", help="recorded persistence root")
+@click.option(
+    "--mode",
+    type=click.Choice(["batch", "speedrun", "realtime"]),
+    default="speedrun",
+    help="replay pacing: batch/speedrun = as fast as possible, realtime = original pacing",
+)
+@click.option("-t", "--threads", type=int, default=1)
+@click.option("-n", "--processes", type=int, default=1)
+@click.option(
+    "--continue-after-replay/--no-continue-after-replay",
+    default=False,
+    help="after replaying the recording, keep consuming live sources",
+)
+@click.argument("program", nargs=-1, type=click.UNPROCESSED)
+def replay(record_path, mode, threads, processes, continue_after_replay, program):
+    """Re-run PROGRAM against inputs recorded by `spawn --record`."""
+    env = dict(os.environ)
+    env["PATHWAY_THREADS"] = str(threads)
+    env["PATHWAY_PROCESSES"] = str(processes)
+    env["PATHWAY_REPLAY_STORAGE"] = record_path
+    env["PATHWAY_PERSISTENT_STORAGE"] = record_path
+    env["PATHWAY_REPLAY_MODE"] = mode
+    env["PATHWAY_CONTINUE_AFTER_REPLAY"] = "1" if continue_after_replay else "0"
+    sys.exit(_spawn_processes(env, processes, program))
+
+
+def main() -> None:
+    cli()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,143 @@
+"""Central runtime configuration from ``PATHWAY_*`` environment variables.
+
+Role of the reference's ``PathwayConfig`` (``python/pathway/internals/config.py``,
+176 LoC) and the Rust ``Config::from_env`` (``src/engine/dataflow/config.rs:88-127``):
+one object owning every env knob, so subsystems stop reading ``os.environ`` ad hoc.
+Properties read the environment live — cheap, and subprocess tests that mutate env
+see fresh values without cache invalidation.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any
+
+
+def _env_int(name: str, default: int) -> int:
+    try:
+        return int(os.environ.get(name, default))
+    except ValueError:
+        raise ValueError(f"{name} must be an integer, got {os.environ[name]!r}") from None
+
+
+def _env_float(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, default))
+    except ValueError:
+        raise ValueError(f"{name} must be a number, got {os.environ[name]!r}") from None
+
+
+def _env_bool(name: str, default: bool) -> bool:
+    raw = os.environ.get(name)
+    if raw is None:
+        return default
+    return raw.strip().lower() not in ("", "0", "false", "no", "off")
+
+
+class PathwayConfig:
+    """Live view of the ``PATHWAY_*`` environment."""
+
+    # ---- worker topology ----------------------------------------------------
+    @property
+    def threads(self) -> int:
+        return max(1, _env_int("PATHWAY_THREADS", 1))
+
+    @property
+    def processes(self) -> int:
+        return max(1, _env_int("PATHWAY_PROCESSES", 1))
+
+    @property
+    def process_id(self) -> int:
+        return _env_int("PATHWAY_PROCESS_ID", 0)
+
+    @property
+    def first_port(self) -> int:
+        return _env_int("PATHWAY_FIRST_PORT", 21000)
+
+    @property
+    def barrier_timeout(self) -> float:
+        return _env_float("PATHWAY_BARRIER_TIMEOUT", 120.0)
+
+    # ---- persistence / replay ----------------------------------------------
+    @property
+    def persistent_storage(self) -> str | None:
+        return os.environ.get("PATHWAY_PERSISTENT_STORAGE")
+
+    @property
+    def replay_storage(self) -> str | None:
+        return os.environ.get("PATHWAY_REPLAY_STORAGE")
+
+    @property
+    def replay_mode(self) -> str:
+        return os.environ.get("PATHWAY_REPLAY_MODE", "speedrun")
+
+    @property
+    def continue_after_replay(self) -> bool:
+        return _env_bool("PATHWAY_CONTINUE_AFTER_REPLAY", True)
+
+    # ---- behavior flags -----------------------------------------------------
+    @property
+    def terminate_on_error(self) -> bool:
+        return _env_bool("PATHWAY_TERMINATE_ON_ERROR", True)
+
+    @property
+    def runtime_typechecking(self) -> bool:
+        return _env_bool("PATHWAY_RUNTIME_TYPECHECKING", False)
+
+    @property
+    def ignore_asserts(self) -> bool:
+        return _env_bool("PATHWAY_IGNORE_ASSERTS", False)
+
+    @property
+    def monitoring_server(self) -> str | None:
+        return os.environ.get("PATHWAY_MONITORING_SERVER")
+
+    @property
+    def run_id(self) -> str:
+        return os.environ.get("PATHWAY_RUN_ID", "")
+
+    # ---- helpers ------------------------------------------------------------
+    @property
+    def total_workers(self) -> int:
+        return self.threads * self.processes
+
+    def spawn_env(self, process_id: int) -> dict[str, str]:
+        """Env block for a child process of ``pathway_tpu spawn``."""
+        env = dict(os.environ)
+        env["PATHWAY_THREADS"] = str(self.threads)
+        env["PATHWAY_PROCESSES"] = str(self.processes)
+        env["PATHWAY_PROCESS_ID"] = str(process_id)
+        env["PATHWAY_FIRST_PORT"] = str(self.first_port)
+        return env
+
+    def to_dict(self) -> dict[str, Any]:
+        return {
+            name: getattr(self, name)
+            for name in (
+                "threads",
+                "processes",
+                "process_id",
+                "first_port",
+                "barrier_timeout",
+                "persistent_storage",
+                "replay_storage",
+                "replay_mode",
+                "continue_after_replay",
+                "terminate_on_error",
+                "runtime_typechecking",
+                "monitoring_server",
+                "run_id",
+            )
+        }
+
+
+pathway_config = PathwayConfig()
+
+
+def get_pathway_config() -> PathwayConfig:
+    return pathway_config
+
+
+def set_license_key(key: str | None) -> None:
+    """Reference API parity (``pw.set_license_key``) — licensing is not
+    replicated (BUSL gating has no TPU-build equivalent); accepted and ignored."""

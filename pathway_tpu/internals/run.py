@@ -8,10 +8,10 @@ global graph, tree-shake, instantiate the engine dataflow, and drive it to compl
 
 from __future__ import annotations
 
-import os
 from typing import Any
 
 from pathway_tpu.engine.runtime import Runtime
+from pathway_tpu.internals.config import get_pathway_config
 from pathway_tpu.internals.parse_graph import G
 
 
@@ -30,7 +30,7 @@ def resolved_n_workers(n_workers: int | None = None) -> int:
     threads resolution, ``internals/config.py``)."""
     if n_workers is not None:
         return max(1, int(n_workers))
-    return max(1, int(os.environ.get("PATHWAY_THREADS", "1")))
+    return get_pathway_config().threads
 
 
 def make_runtime(
@@ -41,7 +41,7 @@ def make_runtime(
 ):
     """Runtime factory honoring the worker count (single-worker ``Runtime`` or
     thread-sharded ``ShardedRuntime``)."""
-    if int(os.environ.get("PATHWAY_PROCESSES", "1")) > 1:
+    if get_pathway_config().processes > 1:
         from pathway_tpu.parallel.cluster import ClusterRuntime
 
         return ClusterRuntime(
@@ -86,6 +86,22 @@ def run(
         monitoring_level=monitoring_level,
         autocommit_duration_ms=autocommit_duration_ms,
     )
+    if persistence_config is None:
+        # CLI contract: `spawn --record` / `replay` point PATHWAY_PERSISTENT_STORAGE /
+        # PATHWAY_REPLAY_STORAGE at a recording root (reference: cli.py:253 + config.py)
+        import os as _os
+
+        cfg = get_pathway_config()
+        auto_root = cfg.replay_storage or (
+            cfg.persistent_storage if _os.environ.get("PATHWAY_RECORD") else None
+        )
+        if auto_root is not None:
+            from pathway_tpu import persistence as _p
+
+            persistence_config = _p.Config(
+                backend=_p.Backend.filesystem(auto_root),
+                continue_after_replay=cfg.continue_after_replay,
+            )
     if persistence_config is not None:
         from pathway_tpu.persistence import attach_persistence
 

@@ -92,8 +92,10 @@ class InMemoryCache(CacheStrategy):
 
 class DiskCache(CacheStrategy):
     def __init__(self, directory: str | None = None):
+        from pathway_tpu.internals.config import get_pathway_config
+
         self.directory = directory or os.path.join(
-            os.environ.get("PATHWAY_PERSISTENT_STORAGE", ".pathway_cache"), "udf_cache"
+            get_pathway_config().persistent_storage or ".pathway_cache", "udf_cache"
         )
         os.makedirs(self.directory, exist_ok=True)
 

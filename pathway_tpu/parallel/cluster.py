@@ -37,22 +37,20 @@ import numpy as np
 
 from pathway_tpu.engine.blocks import DeltaBatch
 from pathway_tpu.engine.graph import END_OF_STREAM, SOLO, Node
+from pathway_tpu.internals.config import get_pathway_config
 from pathway_tpu.internals.logical import BuildContext, LogicalNode
 from pathway_tpu.parallel.mesh import shard_of_keys
 
 
 def cluster_env() -> tuple[int, int, int, int]:
-    """(threads, processes, process_id, first_port) from PATHWAY_* env."""
-    threads = max(1, int(os.environ.get("PATHWAY_THREADS", "1")))
-    processes = max(1, int(os.environ.get("PATHWAY_PROCESSES", "1")))
-    pid = int(os.environ.get("PATHWAY_PROCESS_ID", "0"))
-    first_port = int(os.environ.get("PATHWAY_FIRST_PORT", "21000"))
-    return threads, processes, pid, first_port
+    """(threads, processes, process_id, first_port) from PathwayConfig."""
+    cfg = get_pathway_config()
+    return cfg.threads, cfg.processes, cfg.process_id, cfg.first_port
 
 
 def barrier_timeout() -> float:
     """Seconds a barrier participant waits before declaring a peer dead."""
-    return float(os.environ.get("PATHWAY_BARRIER_TIMEOUT", "120"))
+    return get_pathway_config().barrier_timeout
 
 
 def _send_msg(sock: socket.socket, obj: Any) -> None:

@@ -36,17 +36,15 @@ def distributed_initialize(
     """
     import jax
 
-    num_processes = num_processes or int(os.environ.get("PATHWAY_PROCESSES", "1"))
+    from pathway_tpu.internals.config import get_pathway_config
+
+    cfg = get_pathway_config()
+    num_processes = num_processes or cfg.processes
     if num_processes <= 1:
         return
-    process_id = (
-        process_id
-        if process_id is not None
-        else int(os.environ.get("PATHWAY_PROCESS_ID", "0"))
-    )
+    process_id = process_id if process_id is not None else cfg.process_id
     coordinator_address = coordinator_address or os.environ.get(
-        "PATHWAY_COORDINATOR",
-        f"127.0.0.1:{os.environ.get('PATHWAY_FIRST_PORT', '10100')}",
+        "PATHWAY_COORDINATOR", f"127.0.0.1:{cfg.first_port}"
     )
     jax.distributed.initialize(
         coordinator_address=coordinator_address,

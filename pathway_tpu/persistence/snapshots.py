@@ -34,10 +34,17 @@ _META = "metadata"
 class _PersistedInput:
     """Wraps one StreamInputNode: logs pushes, skips re-read events on restart."""
 
-    def __init__(self, pid: str, node: ops.StreamInputNode, backend: KVBackend):
+    def __init__(
+        self,
+        pid: str,
+        node: ops.StreamInputNode,
+        backend: KVBackend,
+        live_after_replay: bool = True,
+    ):
         self.pid = pid
         self.node = node
         self.backend = backend
+        self.live_after_replay = live_after_replay
         self.buffer: list[tuple[int, tuple | None, int]] = []
         self.stored_offset = 0  # events already persisted (skip this many live)
         self.seen_live = 0
@@ -95,6 +102,9 @@ class _PersistedInput:
             if me.seen_live <= me.stored_offset:
                 return  # already replayed from the snapshot; deterministic
                 # sources re-produce their prefix — drop it (offset seek)
+            if not me.live_after_replay:
+                return  # replay-only run (continue_after_replay=False):
+                # the recording is the whole input; live traffic is ignored
             me.buffer.append((key, values, diff))
             original_push(key, values, diff)
 
@@ -138,7 +148,14 @@ class Persistence:
                 i = seen.get(lnode.name, 0)
                 seen[lnode.name] = i + 1
                 pid = f"{lnode.name}-{i}"
-            self.inputs.append(_PersistedInput(pid, node, self.backend))
+            self.inputs.append(
+                _PersistedInput(
+                    pid,
+                    node,
+                    self.backend,
+                    live_after_replay=getattr(self.config, "continue_after_replay", True),
+                )
+            )
         for p in self.inputs:
             p.replay()
 
