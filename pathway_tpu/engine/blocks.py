@@ -133,10 +133,17 @@ def make_column(values: list, np_dtype: np.dtype) -> np.ndarray:
         arr = np.empty(len(values), dtype=object)
         arr[:] = values
         return arr
-    if np_dtype.kind != "b":
+    if np_dtype.kind == "b":
+        # np.asarray silently coerces None to False, so bool needs the explicit
+        # None scan before the typed conversion
+        if not any(v is None for v in values):
+            try:
+                return np.asarray(values, dtype=np_dtype)
+            except (TypeError, ValueError):
+                pass
+    else:
         # direct conversion first: the common all-typed case needs no None scan
-        # (None raises TypeError and lands in the fallback below). bool is
-        # excluded: np.asarray silently coerces None to False
+        # (None raises TypeError and lands in the fallback below)
         try:
             return np.asarray(values, dtype=np_dtype)
         except (TypeError, ValueError):

@@ -548,6 +548,22 @@ class GroupByNode(Node):
             DeltaBatch.from_rows(out_keys, out_rows, self.out_columns, time, diffs=out_diffs)
         ]
 
+    def on_end(self):
+        # join padding parks rows under NONE_KEY transiently and corrections
+        # normally clear it; rows still there when the stream closes had a
+        # genuinely-None id-expression and were excluded from output — say so
+        # instead of losing them silently (reference routes error-keyed rows to
+        # the error log)
+        st = self.state.get(self.NONE_KEY)
+        if st is not None and st["n"] > 0:
+            import warnings
+
+            warnings.warn(
+                f"groupby: {st['n']} row(s) with a None grouping id were "
+                "excluded from the output",
+                stacklevel=2,
+            )
+
 
 def _tuple_differs(a, b) -> bool:
     if (a is None) != (b is None):

@@ -76,11 +76,17 @@ def _one_step(WE: pw.Table, clustering: pw.Table) -> pw.Table:
     )
 
     best = argmax_rows(gains, gains.u, what=gains.gain)
+    # move priority is salted with the vertex's CURRENT cluster: every executed
+    # move re-randomizes the winner's priority next round (no fixed-priority
+    # starvation, the reference's fingerprint((x, iter)) intent) while staying
+    # constant at the fixed point so pw.iterate still converges
     annotated = best.select(
         u=best.u,
         vc=best.c,
         uc=clustering.ix(best.u).c,
-        r=pw.apply_with_type(lambda k: fingerprint(k, format="i64"), int, best.u),
+        r=pw.apply_with_type(
+            lambda k, c: fingerprint((k, c), format="i64"), int, best.u, clustering.ix(best.u).c
+        ),
     )
     movers = annotated.filter(annotated.vc != annotated.uc)
 

@@ -1,8 +1,11 @@
-"""Row-filtering helpers (reference: ``python/pathway/stdlib/utils/filtering.py``).
+"""Row-filtering helpers (role of the reference's
+``python/pathway/stdlib/utils/filtering.py``: keep, per group, only the row where
+``what`` is extreme).
 
-``argmax_rows``/``argmin_rows`` keep, per group, the single row where ``what`` is
-extreme — implemented as an argmax/argmin reduce whose winning row id re-keys a
-restriction of the original table.
+Implementation here: a single extremal reduce drives ``ix`` lookups back into the
+source table — the winner row is re-materialized by pointer rather than by
+restricting the original universe, so the result's ids are the *group* ids (stable
+under winner churn), and no subset promise is needed.
 """
 
 from __future__ import annotations
@@ -10,21 +13,18 @@ from __future__ import annotations
 import pathway_tpu as pw
 
 
-def argmax_rows(table: pw.Table, *on: pw.ColumnReference, what) -> pw.Table:
-    winners = (
-        table.groupby(*on)
-        .reduce(argmax_id=pw.reducers.argmax(what))
-        .with_id(pw.this.argmax_id)
-        .promise_universe_is_subset_of(table)
+def _extremal_rows(table: pw.Table, on, what, reducer) -> pw.Table:
+    champions = table.groupby(*on).reduce(winner=reducer(what))
+    return champions.select(
+        **{name: table.ix(champions.winner)[name] for name in table.column_names()}
     )
-    return table.restrict(winners, strict=False)
+
+
+def argmax_rows(table: pw.Table, *on: pw.ColumnReference, what) -> pw.Table:
+    """One row per group of ``on``: the row maximizing ``what``."""
+    return _extremal_rows(table, on, what, pw.reducers.argmax)
 
 
 def argmin_rows(table: pw.Table, *on: pw.ColumnReference, what) -> pw.Table:
-    winners = (
-        table.groupby(*on)
-        .reduce(argmin_id=pw.reducers.argmin(what))
-        .with_id(pw.this.argmin_id)
-        .promise_universe_is_subset_of(table)
-    )
-    return table.restrict(winners, strict=False)
+    """One row per group of ``on``: the row minimizing ``what``."""
+    return _extremal_rows(table, on, what, pw.reducers.argmin)

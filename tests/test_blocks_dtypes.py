@@ -1,0 +1,27 @@
+"""Column-storage dtype behaviors (ADVICE r2: bool columns must stay typed)."""
+
+import numpy as np
+
+from pathway_tpu.engine.blocks import make_column
+
+
+def test_bool_column_stays_typed_without_none():
+    col = make_column([True, False, True], np.dtype(bool))
+    assert col.dtype == np.dtype(bool)
+    assert col.tolist() == [True, False, True]
+
+
+def test_bool_column_with_none_falls_back_to_object():
+    col = make_column([True, None, False], np.dtype(bool))
+    assert col.dtype == np.dtype(object)
+    assert col[1] is None  # not coerced to False
+
+
+def test_int_column_typed():
+    assert make_column([1, 2, 3], np.dtype(np.int64)).dtype == np.dtype(np.int64)
+
+
+def test_float_column_none_becomes_nan():
+    col = make_column([1.0, None], np.dtype(np.float64))
+    assert col.dtype == np.dtype(np.float64)
+    assert np.isnan(col[1])
