@@ -2,10 +2,66 @@
 DataStorage/DataFormat descriptor; here each module wires source/sink engine nodes
 directly)."""
 
-from pathway_tpu.io import csv, fs, http, jsonlines, plaintext, python
+from pathway_tpu.io import (
+    airbyte,
+    bigquery,
+    csv,
+    debezium,
+    deltalake,
+    elasticsearch,
+    fs,
+    gdrive,
+    http,
+    iceberg,
+    jsonlines,
+    kafka,
+    logstash,
+    minio,
+    mongodb,
+    nats,
+    plaintext,
+    postgres,
+    pubsub,
+    pyfilesystem,
+    python,
+    s3,
+    s3_csv,
+    slack,
+    sqlite,
+)
+from pathway_tpu.io import kafka as redpanda  # reference alias: io/redpanda = io/kafka
 from pathway_tpu.io._subscribe import subscribe
 from pathway_tpu.io.null import write as _null_write
 
-__all__ = ["csv", "fs", "http", "jsonlines", "plaintext", "python", "subscribe", "null"]
+__all__ = [
+    "airbyte",
+    "bigquery",
+    "csv",
+    "debezium",
+    "deltalake",
+    "elasticsearch",
+    "fs",
+    "gdrive",
+    "http",
+    "iceberg",
+    "jsonlines",
+    "kafka",
+    "logstash",
+    "minio",
+    "mongodb",
+    "nats",
+    "plaintext",
+    "postgres",
+    "pubsub",
+    "pyfilesystem",
+    "python",
+    "redpanda",
+    "s3",
+    "s3_csv",
+    "slack",
+    "sqlite",
+    "subscribe",
+    "null",
+]
 
 from pathway_tpu.io import null  # noqa: E402

@@ -1,0 +1,16 @@
+"""Shared dependency-gate helper for connectors whose client libraries are not in
+this image (reference modules: minio, s3_csv, deltalake, iceberg, nats, pubsub,
+gdrive, airbyte, logstash, pyfilesystem, sharepoint). Each gated module keeps the
+reference's call signature and raises a clear NotImplementedError."""
+
+from __future__ import annotations
+
+
+def gate(connector: str, requirement: str):
+    def _raise(*args, **kwargs):
+        raise NotImplementedError(
+            f"pw.io.{connector} requires {requirement}, which is not available in "
+            "this environment"
+        )
+
+    return _raise

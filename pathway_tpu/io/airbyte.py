@@ -1,0 +1,5 @@
+"""Gated connector: reference `python/pathway/io/airbyte`. See _gated.py."""
+
+from pathway_tpu.io._gated import gate
+
+read = gate("airbyte", "Docker or an airbyte-serverless runtime")

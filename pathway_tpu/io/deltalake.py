@@ -1,0 +1,6 @@
+"""Gated connector: reference `python/pathway/io/deltalake`. See _gated.py."""
+
+from pathway_tpu.io._gated import gate
+
+read = gate("deltalake", "the deltalake library")
+write = gate("deltalake", "the deltalake library")

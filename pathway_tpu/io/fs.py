@@ -39,24 +39,7 @@ def _list_files(path: str) -> list[str]:
     return sorted(_glob.glob(path))
 
 
-def _coerce(tok: str, d: dt.DType) -> Any:
-    d = dt.unoptionalize(d)
-    try:
-        if d == dt.INT:
-            return int(tok)
-        if d == dt.FLOAT:
-            return float(tok)
-        if d == dt.BOOL:
-            return tok.strip().lower() in ("true", "1", "yes", "t")
-        if d == dt.JSON:
-            from pathway_tpu.internals.json import Json
-
-            return Json(_json.loads(tok))
-        return tok
-    except (ValueError, TypeError):
-        from pathway_tpu.internals.errors import ERROR
-
-        return ERROR
+from pathway_tpu.io._format import coerce_scalar as _coerce  # shared Parser-layer coercion
 
 
 def _parse_file(

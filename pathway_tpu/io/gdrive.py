@@ -1,0 +1,5 @@
+"""Gated connector: reference `python/pathway/io/gdrive`. See _gated.py."""
+
+from pathway_tpu.io._gated import gate
+
+read = gate("gdrive", "Google Drive API credentials and network egress")

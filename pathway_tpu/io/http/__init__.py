@@ -7,4 +7,83 @@ in this package in ``_server.py`` on aiohttp.
 
 from pathway_tpu.io.http._server import PathwayWebserver, rest_connector, response_writer
 
-__all__ = ["rest_connector", "response_writer", "PathwayWebserver"]
+
+def read(
+    url: str,
+    *,
+    schema=None,
+    format: str = "json",  # noqa: A002
+    mode: str = "streaming",
+    poll_interval: float = 1.0,
+    request_kwargs: dict | None = None,
+    name: str | None = None,
+    **kwargs,
+):
+    """Poll ``url`` and parse each response body through the format's Parser
+    (reference: ``python/pathway/io/http`` read side)."""
+    import time as _time
+
+    import requests as _requests
+
+    from pathway_tpu.internals import schema as schema_mod
+    from pathway_tpu.io._format import RawMessage, parser_for
+    from pathway_tpu.io.python import ConnectorSubject, read as py_read
+
+    if schema is None:
+        schema = schema_mod.schema_from_types(data=str)
+    parser = parser_for(format, schema)
+
+    class _HttpSubject(ConnectorSubject):
+        def __init__(self) -> None:
+            super().__init__()
+            self._stop = False
+
+        def run(self) -> None:
+            while not self._stop:
+                resp = _requests.get(url, **(request_kwargs or {}))
+                for ev in parser.parse(RawMessage(value=resp.content)):
+                    self._push(ev.values, diff=ev.diff)
+                if mode == "static":
+                    return
+                _time.sleep(poll_interval)
+
+        def on_stop(self) -> None:
+            self._stop = True
+
+    return py_read(_HttpSubject(), schema=schema, name=name or f"http:{url}")
+
+
+def write(
+    table,
+    url: str,
+    *,
+    method: str = "POST",
+    format: str = "json",  # noqa: A002
+    request_kwargs: dict | None = None,
+    **kwargs,
+) -> None:
+    """Send every output diff to ``url`` (reference: io/http write side)."""
+    import requests as _requests
+
+    from pathway_tpu.engine import operators as ops
+    from pathway_tpu.internals.logical import LogicalNode
+    from pathway_tpu.io._format import formatter_for
+
+    cols = table.column_names()
+    fmt = formatter_for(format, cols, **kwargs)
+
+    def on_batch(batch, columns) -> None:
+        for key, diff, row in batch.rows():
+            _requests.request(
+                method, url, data=fmt.format(int(key), row, batch.time, diff),
+                **(request_kwargs or {}),
+            )
+
+    LogicalNode(
+        lambda: ops.CallbackOutputNode(cols, on_batch),
+        [table._node],
+        name=f"http_write:{url}",
+    )._register_as_output()
+
+
+__all__ = ["rest_connector", "response_writer", "PathwayWebserver", "read", "write"]

@@ -1,0 +1,6 @@
+"""Gated connector: reference `python/pathway/io/iceberg`. See _gated.py."""
+
+from pathway_tpu.io._gated import gate
+
+read = gate("iceberg", "the pyiceberg library")
+write = gate("iceberg", "the pyiceberg library")

@@ -1,0 +1,6 @@
+"""Gated connector: reference `python/pathway/io/minio`. See _gated.py."""
+
+from pathway_tpu.io._gated import gate
+
+read = gate("minio", "boto3 (S3-compatible object-store access)")
+write = gate("minio", "boto3 (S3-compatible object-store access)")

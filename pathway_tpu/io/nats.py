@@ -1,0 +1,6 @@
+"""Gated connector: reference `python/pathway/io/nats`. See _gated.py."""
+
+from pathway_tpu.io._gated import gate
+
+read = gate("nats", "the nats-py client")
+write = gate("nats", "the nats-py client")

@@ -1,0 +1,85 @@
+"""PostgreSQL writer (reference: ``PsqlWriter`` ``src/connectors/data_storage.rs:1326``
++ ``PsqlUpdatesFormatter``/``PsqlSnapshotFormatter`` ``data_format.rs:1733,1826``).
+
+``write``: every diff appends an INSERT carrying time/diff columns (updates mode).
+``write_snapshot``: maintains one live row per primary key via upsert/delete — the
+diff-aware snapshot mode. Requires ``psycopg2`` (not in this image; import-gated)."""
+
+from __future__ import annotations
+
+from typing import Any
+
+from pathway_tpu.engine import operators as ops
+from pathway_tpu.internals.logical import LogicalNode
+from pathway_tpu.internals.table import Table
+
+
+def _connect(settings: dict):
+    try:
+        import psycopg2  # noqa: F401
+    except ImportError:
+        raise NotImplementedError(
+            "pw.io.postgres requires psycopg2, which is not available in this "
+            "environment"
+        ) from None
+    import psycopg2
+
+    return psycopg2.connect(**settings)
+
+
+def _register_writer(table: Table, on_batch, name: str) -> None:
+    cols = table.column_names()
+    LogicalNode(
+        lambda: ops.CallbackOutputNode(cols, on_batch),
+        [table._node],
+        name=name,
+    )._register_as_output()
+
+
+def write(table: Table, postgres_settings: dict, table_name: str, **kwargs: Any) -> None:
+    con = _connect(postgres_settings)
+    cols = table.column_names()
+    placeholders = ", ".join(["%s"] * (len(cols) + 2))
+    stmt = (
+        f"INSERT INTO {table_name} ({', '.join(cols)}, time, diff) "  # noqa: S608
+        f"VALUES ({placeholders})"
+    )
+
+    def on_batch(batch, columns) -> None:
+        with con.cursor() as cur:
+            for _key, diff, row in batch.rows():
+                cur.execute(stmt, tuple(row) + (batch.time, diff))
+        con.commit()
+
+    _register_writer(table, on_batch, f"postgres_write:{table_name}")
+
+
+def write_snapshot(
+    table: Table, postgres_settings: dict, table_name: str, primary_key: list[str], **kwargs: Any
+) -> None:
+    con = _connect(postgres_settings)
+    cols = table.column_names()
+    pk = list(primary_key)
+    non_pk = [c for c in cols if c not in pk]
+    placeholders = ", ".join(["%s"] * len(cols))
+    updates = ", ".join(f"{c} = EXCLUDED.{c}" for c in non_pk) or f"{pk[0]} = EXCLUDED.{pk[0]}"
+    upsert = (
+        f"INSERT INTO {table_name} ({', '.join(cols)}) VALUES ({placeholders}) "  # noqa: S608
+        f"ON CONFLICT ({', '.join(pk)}) DO UPDATE SET {updates}"
+    )
+    delete = (
+        f"DELETE FROM {table_name} WHERE "  # noqa: S608
+        + " AND ".join(f"{c} = %s" for c in pk)
+    )
+    pk_idx = [cols.index(c) for c in pk]
+
+    def on_batch(batch, columns) -> None:
+        with con.cursor() as cur:
+            for _key, diff, row in batch.rows():
+                if diff > 0:
+                    cur.execute(upsert, tuple(row))
+                else:
+                    cur.execute(delete, tuple(row[i] for i in pk_idx))
+        con.commit()
+
+    _register_writer(table, on_batch, f"postgres_snapshot:{table_name}")

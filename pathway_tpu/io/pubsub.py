@@ -1,0 +1,5 @@
+"""Gated connector: reference `python/pathway/io/pubsub`. See _gated.py."""
+
+from pathway_tpu.io._gated import gate
+
+write = gate("pubsub", "google-cloud-pubsub")

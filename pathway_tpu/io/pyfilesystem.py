@@ -1,0 +1,5 @@
+"""Gated connector: reference `python/pathway/io/pyfilesystem`. See _gated.py."""
+
+from pathway_tpu.io._gated import gate
+
+read = gate("pyfilesystem", "the fs (PyFilesystem2) library")

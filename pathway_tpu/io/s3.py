@@ -1,0 +1,53 @@
+"""S3 / object-store reader (reference: ``scanner/s3.rs`` + ``python/pathway/io/s3``).
+
+Dependency gate: object-store access needs boto3 (absent in this image) and
+network egress. The API surface matches the reference; calls raise until a client
+library is available."""
+
+from __future__ import annotations
+
+from typing import Any
+
+
+class AwsS3Settings:
+    def __init__(
+        self,
+        bucket_name: str | None = None,
+        access_key: str | None = None,
+        secret_access_key: str | None = None,
+        region: str | None = None,
+        endpoint: str | None = None,
+        with_path_style: bool = False,
+    ):
+        self.bucket_name = bucket_name
+        self.access_key = access_key
+        self.secret_access_key = secret_access_key
+        self.region = region
+        self.endpoint = endpoint
+        self.with_path_style = with_path_style
+
+
+def _gate() -> None:
+    try:
+        import boto3  # noqa: F401
+    except ImportError:
+        raise NotImplementedError(
+            "pw.io.s3 requires boto3 and object-store access, which are not "
+            "available in this environment"
+        ) from None
+
+
+def read(
+    path: str,
+    aws_s3_settings: AwsS3Settings | None = None,
+    *,
+    format: str = "json",  # noqa: A002
+    schema: Any = None,
+    mode: str = "streaming",
+    **kwargs: Any,
+):
+    _gate()
+
+
+def read_from_azure(*args: Any, **kwargs: Any):
+    _gate()

@@ -1,0 +1,5 @@
+"""Gated connector: reference `python/pathway/io/s3_csv`. See _gated.py."""
+
+from pathway_tpu.io._gated import gate
+
+read = gate("s3_csv", "boto3 and object-store access")

@@ -1,0 +1,37 @@
+"""Slack writer (reference: ``python/pathway/io/slack``): posts one message per
+positive output diff to a channel via chat.postMessage."""
+
+from __future__ import annotations
+
+from typing import Any
+
+from pathway_tpu.engine import operators as ops
+from pathway_tpu.internals.logical import LogicalNode
+from pathway_tpu.internals.table import Table
+from pathway_tpu.io._format import SingleColumnFormatter
+
+
+def send_alerts(alerts: Table, slack_channel_id: str, slack_token: str, **kwargs: Any) -> None:
+    import requests
+
+    cols = alerts.column_names()
+    fmt = SingleColumnFormatter(cols, cols[0])
+
+    def on_batch(batch, columns) -> None:
+        for key, diff, row in batch.rows():
+            if diff <= 0:
+                continue
+            requests.post(
+                "https://slack.com/api/chat.postMessage",
+                headers={"Authorization": f"Bearer {slack_token}"},
+                json={
+                    "channel": slack_channel_id,
+                    "text": fmt.format(int(key), row, batch.time, diff).decode(),
+                },
+            )
+
+    LogicalNode(
+        lambda: ops.CallbackOutputNode(cols, on_batch),
+        [alerts._node],
+        name=f"slack:{slack_channel_id}",
+    )._register_as_output()

@@ -40,17 +40,31 @@ class _PersistedInput:
         node: ops.StreamInputNode,
         backend: KVBackend,
         live_after_replay: bool = True,
+        subject: Any = None,
     ):
         self.pid = pid
         self.node = node
         self.backend = backend
         self.live_after_replay = live_after_replay
+        # seekable sources (offset_state/seek, e.g. Kafka partitions) restart by
+        # seeking past the persisted offsets instead of dropping a replayed
+        # event-count prefix — the prefix-drop is only sound for sources that
+        # re-produce events in identical order
+        self.subject = subject
+        self.seekable = subject is not None and hasattr(subject, "seek") and hasattr(
+            subject, "offset_state"
+        )
+        self.reader_state: Any = None
         self.buffer: list[tuple[int, tuple | None, int]] = []
         self.stored_offset = 0  # events already persisted (skip this many live)
         self.seen_live = 0
         self.n_chunks = 0
         self._load_metadata()
         self.persisted = self.stored_offset
+        if self.seekable:
+            if self.reader_state is not None:
+                subject.seek(self.reader_state)
+            self.stored_offset = 0  # seek replaces the prefix-drop entirely
         self._install()
 
     # -- storage ------------------------------------------------------------
@@ -63,11 +77,18 @@ class _PersistedInput:
             meta = pickle.loads(raw)
             self.stored_offset = meta["offset"]
             self.n_chunks = meta["chunks"]
+            self.reader_state = meta.get("reader")
 
     def _flush_metadata(self) -> None:
         self.backend.put(
             self._key(_META),
-            pickle.dumps({"offset": self.persisted, "chunks": self.n_chunks}),
+            pickle.dumps(
+                {
+                    "offset": self.persisted,
+                    "chunks": self.n_chunks,
+                    "reader": self.reader_state,
+                }
+            ),
         )
 
     def replay(self) -> None:
@@ -81,9 +102,20 @@ class _PersistedInput:
                 self._original_push(key, values, diff)
 
     def flush(self) -> None:
-        if not self.buffer:
-            return
-        chunk, self.buffer = self.buffer, []
+        # for seekable sources, buffer capture + reader-state read happen under
+        # the subject's sync_lock so the stored offsets exactly cover the
+        # persisted events (no torn batch on crash)
+        lock = getattr(self.subject, "sync_lock", None) if self.seekable else None
+        if lock is not None:
+            with lock:
+                if not self.buffer:
+                    return
+                chunk, self.buffer = self.buffer, []
+                self.reader_state = self.subject.offset_state()
+        else:
+            if not self.buffer:
+                return
+            chunk, self.buffer = self.buffer, []
         self.backend.put(
             self._key(f"{_CHUNK}_{self.n_chunks:08d}"), pickle.dumps(chunk)
         )
@@ -117,8 +149,9 @@ class _PersistedInput:
 
 
 class Persistence:
-    def __init__(self, config):
+    def __init__(self, config, runtime=None):
         self.config = config
+        self.runtime = runtime
         self.backend = backend_from_config(config.backend)
         if config.persistence_mode == "operator_persisting":
             raise NotImplementedError(
@@ -154,10 +187,19 @@ class Persistence:
                     node,
                     self.backend,
                     live_after_replay=getattr(self.config, "continue_after_replay", True),
+                    subject=self._subject_of(node),
                 )
             )
         for p in self.inputs:
             p.replay()
+
+    def _subject_of(self, node) -> Any:
+        """Find the connector subject feeding ``node`` (for seekable sources)."""
+        for driver in getattr(self.runtime, "connectors", []) or []:
+            subject = getattr(driver, "subject", None)
+            if subject is not None and getattr(subject, "_node", None) is node:
+                return subject
+        return None
 
     def on_tick_done(self, time: int) -> None:
         for p in self.inputs:
@@ -168,7 +210,7 @@ class Persistence:
 
 
 def attach(runtime, config) -> None:
-    runtime.persistence = Persistence(config)
+    runtime.persistence = Persistence(config, runtime)
     if config.backend.kind == "filesystem" and config.backend.path:
         # colocate UDF DiskCache with the persistent storage (reference:
         # UdfCaching rides the same machinery, internals/udfs/caches.py:35)

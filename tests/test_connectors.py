@@ -1,0 +1,233 @@
+"""Parser/Formatter layer + Kafka connector tests (reference:
+``src/connectors/data_format.rs`` round-trips, ``integration_tests/kafka/`` and
+``integration_tests/wordcount/test_recovery.py`` kill/restart semantics)."""
+
+from __future__ import annotations
+
+import csv
+import json
+import os
+import signal
+import subprocess
+import sys
+import textwrap
+import time
+
+import pathway_tpu as pw
+from pathway_tpu.io._format import (
+    DebeziumMessageParser,
+    DsvFormatter,
+    DsvParser,
+    JsonLinesFormatter,
+    JsonLinesParser,
+    RawMessage,
+)
+from pathway_tpu.io.kafka import MockKafkaBroker
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ------------------------------------------------------------------ parser units
+def test_dsv_parser_types_and_errors():
+    S = pw.schema_from_types(name=str, qty=int, price=float)
+    p = DsvParser(S)
+    (ev,) = p.parse(RawMessage(value=b"widget,3,1.5"))
+    assert ev.values == ("widget", 3, 1.5) and ev.diff == 1
+    (bad,) = p.parse(RawMessage(value="widget,notanint,1.5"))
+    from pathway_tpu.internals.errors import ERROR
+
+    assert bad.values[1] is ERROR
+
+
+def test_jsonlines_parser_multiline():
+    S = pw.schema_from_types(a=int, b=str)
+    p = JsonLinesParser(S)
+    evs = p.parse(RawMessage(value='{"a": 1, "b": "x"}\n{"a": 2, "b": "y"}'))
+    assert [e.values for e in evs] == [(1, "x"), (2, "y")]
+
+
+def test_debezium_parser_ops():
+    S = pw.schema_from_types(id=int, v=str)
+    p = DebeziumMessageParser(S)
+    ins = p.parse(
+        RawMessage(value=json.dumps({"payload": {"op": "c", "after": {"id": 1, "v": "a"}}}))
+    )
+    assert [(e.values, e.diff) for e in ins] == [((1, "a"), 1)]
+    upd = p.parse(
+        RawMessage(
+            value=json.dumps(
+                {"payload": {"op": "u", "before": {"id": 1, "v": "a"}, "after": {"id": 1, "v": "b"}}}
+            )
+        )
+    )
+    assert [(e.values, e.diff) for e in upd] == [((1, "a"), -1), ((1, "b"), 1)]
+    dele = p.parse(
+        RawMessage(value=json.dumps({"payload": {"op": "d", "before": {"id": 1, "v": "b"}}}))
+    )
+    assert [(e.values, e.diff) for e in dele] == [((1, "b"), -1)]
+
+
+def test_formatters_roundtrip():
+    cols = ["name", "qty"]
+    jf = JsonLinesFormatter(cols)
+    rec = json.loads(jf.format(7, ("w", 3), 10, 1))
+    assert rec == {"name": "w", "qty": 3, "time": 10, "diff": 1}
+    df = DsvFormatter(cols)
+    assert df.format(7, ("w", 3), 10, -1) == b"w,3,10,-1"
+
+
+# ------------------------------------------------------------------ kafka in-proc
+def test_kafka_static_roundtrip():
+    broker = MockKafkaBroker()
+    broker.create_topic("t", partitions=3)
+    for i in range(30):
+        broker.produce("t", json.dumps({"k": i % 4, "v": i}), key=str(i), partition=i % 3)
+    S = pw.schema_from_types(k=int, v=int)
+    t = pw.io.kafka.read(broker, "t", schema=S, format="json", mode="static")
+    g = t.groupby(t.k).reduce(t.k, s=pw.reducers.sum(t.v), c=pw.reducers.count())
+    cap = pw.debug._capture(g)
+    got = {row[0]: (row[1], row[2]) for row in dict(cap.rows).values()}
+    import numpy as np
+
+    expect = {}
+    for i in range(30):
+        s, c = expect.get(i % 4, (0, 0))
+        expect[i % 4] = (s + i, c + 1)
+    assert got == expect
+
+
+def test_kafka_write_then_read_back():
+    broker = MockKafkaBroker()
+    t = pw.debug.table_from_rows(
+        pw.schema_from_types(w=str, n=int), [("a", 1), ("b", 2)]
+    )
+    pw.io.kafka.write(t, broker, "out", format="json", key_column="w")
+    pw.run()
+    msgs = broker.fetch("out", 0, 0)
+    recs = sorted(json.loads(v)["w"] for _k, v in msgs)
+    assert recs == ["a", "b"]
+    keys = sorted(k for k, _v in msgs)
+    assert keys == ["a", "b"]
+
+
+def test_kafka_debezium_cdc_stream():
+    broker = MockKafkaBroker()
+    broker.create_topic("cdc")
+    S = pw.schema_from_types(id=int, v=str)
+    for op, before, after in [
+        ("c", None, {"id": 1, "v": "a"}),
+        ("c", None, {"id": 2, "v": "b"}),
+        ("u", {"id": 1, "v": "a"}, {"id": 1, "v": "z"}),
+        ("d", {"id": 2, "v": "b"}, None),
+    ]:
+        broker.produce(
+            "cdc", json.dumps({"payload": {"op": op, "before": before, "after": after}})
+        )
+
+    class PkS(pw.Schema):
+        id: int = pw.column_definition(primary_key=True)
+        v: str
+
+    t = pw.io.kafka.read(broker, "cdc", schema=PkS, format="debezium", mode="static")
+    cap = pw.debug._capture(t)
+    rows = sorted(dict(cap.rows).values())
+    assert rows == [(1, "z")]
+
+
+# ---------------------------------------------------------------- wordcount + kill
+_WORDCOUNT = textwrap.dedent(
+    """
+    import os
+    import sys
+
+    import pathway_tpu as pw
+    from pathway_tpu.io.kafka import MockKafkaBroker
+
+    broker = MockKafkaBroker(path=os.environ["BROKER_PATH"])
+    expected = int(os.environ["EXPECTED_WORDS"])
+    words = pw.io.kafka.read(broker, "words", format="plaintext", mode="streaming")
+    counts = words.groupby(words.data).reduce(words.data, c=pw.reducers.count())
+    pw.io.fs.write(counts, sys.argv[1], format="csv")
+
+    seen = {}
+    def on_change(key, row, time, is_addition):
+        seen[key] = seen.get(key, 0) + (row["c"] if is_addition else -row["c"])
+        if sum(seen.values()) >= expected:
+            rt = pw.internals.run.current_runtime()
+            if rt is not None:
+                rt.request_stop()
+    pw.io.subscribe(counts, on_change=on_change)
+    pw.run(
+        persistence_config=pw.persistence.Config(
+            backend=pw.persistence.Backend.filesystem(os.environ["PSTORE"])
+        )
+    )
+    """
+)
+
+
+def _spawn_wordcount(script, out, env):
+    return subprocess.Popen(
+        [sys.executable, script, out],
+        env=env,
+        stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT,
+        text=True,
+    )
+
+
+def test_wordcount_kill_restart_recovery(tmp_path):
+    script = tmp_path / "wc.py"
+    script.write_text(_WORDCOUNT)
+    broker_path = str(tmp_path / "broker")
+    pstore = str(tmp_path / "pstore")
+    out = str(tmp_path / "out.csv")
+
+    words = [f"w{i % 23}" for i in range(400)]
+    broker = MockKafkaBroker(path=broker_path)
+    broker.create_topic("words", partitions=2)
+    for i, w in enumerate(words[:200]):
+        broker.produce("words", w, partition=i % 2)
+
+    env = dict(
+        os.environ,
+        PYTHONPATH=REPO,
+        JAX_PLATFORMS="cpu",
+        BROKER_PATH=broker_path,
+        PSTORE=pstore,
+        EXPECTED_WORDS=str(len(words)),
+    )
+    p = _spawn_wordcount(str(script), out, env)
+    # wait until the first half is visibly processed, then kill -9 mid-stream
+    deadline = time.time() + 60
+    while time.time() < deadline:
+        if os.path.exists(out) and sum(1 for _ in open(out)) > 5:
+            break
+        time.sleep(0.1)
+    else:
+        p.kill()
+        raise AssertionError("no output before kill: " + (p.communicate()[0] or ""))
+    time.sleep(0.3)
+    p.send_signal(signal.SIGKILL)
+    p.wait()
+
+    # remaining input arrives while the pipeline is down
+    for i, w in enumerate(words[200:]):
+        broker.produce("words", w, partition=i % 2)
+
+    p = _spawn_wordcount(str(script), out, env)
+    stdout, _ = p.communicate(timeout=120)
+    assert p.returncode == 0, stdout
+
+    # net state from the diff stream must equal the ground-truth counts
+    state: dict[str, int] = {}
+    with open(out) as fh:
+        for rec in csv.DictReader(fh):
+            w, c, d = rec["data"], int(rec["c"]), int(rec["diff"])
+            state[w] = state.get(w, 0) + c * d
+            if state[w] == 0:
+                del state[w]
+    truth: dict[str, int] = {}
+    for w in words:
+        truth[w] = truth.get(w, 0) + 1
+    assert state == truth
