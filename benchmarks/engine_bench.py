@@ -17,15 +17,25 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import numpy as np
 
 
-def run(n: int = 1_000_000) -> dict:
+def run(n: int = 1_000_000, n_times: int = 1) -> dict:
+    """``n_times=1``: one static load. ``n_times>1``: the same rows split over
+    that many logical timestamps — the streaming/incremental path."""
     import pathway_tpu as pw
     from tests.utils import rows_of
 
     rng = np.random.default_rng(0)
-    left = pw.debug.table_from_rows(
-        pw.schema_from_types(k=int, v=int),
-        list(zip(rng.integers(0, n // 10, n).tolist(), rng.integers(0, 100, n).tolist())),
-    )
+    lk = rng.integers(0, n // 10, n).tolist()
+    lv = rng.integers(0, 100, n).tolist()
+    schema_l = pw.schema_from_types(k=int, v=int)
+    if n_times == 1:
+        left = pw.debug.table_from_rows(schema_l, list(zip(lk, lv)))
+    else:
+        per = (n + n_times - 1) // n_times
+        left = pw.debug.table_from_rows(
+            schema_l,
+            [(k, v, i // per, 1) for i, (k, v) in enumerate(zip(lk, lv))],
+            is_stream=True,
+        )
     right = pw.debug.table_from_rows(
         pw.schema_from_types(k=int, w=int),
         list(zip(range(n // 10), rng.integers(0, 100, n // 10).tolist())),
@@ -36,8 +46,13 @@ def run(n: int = 1_000_000) -> dict:
     t0 = time.perf_counter()
     out = rows_of(g)
     elapsed = time.perf_counter() - t0
+    label = (
+        f"{n} rows static load"
+        if n_times == 1
+        else f"{n} rows over {n_times} timestamps"
+    )
     return {
-        "metric": f"engine rows/s (filter+join+groupby, {n} rows static load)",
+        "metric": f"engine rows/s (filter+join+groupby, {label})",
         "value": round(n / elapsed, 0),
         "unit": "rows/s",
         "out_groups": len(out),
@@ -47,4 +62,5 @@ def run(n: int = 1_000_000) -> dict:
 
 if __name__ == "__main__":
     n = int(sys.argv[1]) if len(sys.argv) > 1 else 1_000_000
-    print(json.dumps(run(n)))
+    n_times = int(sys.argv[2]) if len(sys.argv) > 2 else 1
+    print(json.dumps(run(n, n_times)))

@@ -164,13 +164,14 @@ def table_from_rows(
         # rows are (…values, time, diff)
         from pathway_tpu.io.python import _StaticStreamSubject, read_subject
 
+        seq_keys = None if pks else sequential_keys(0, len(rows))
         events = []
         for i, r in enumerate(rows):
             values, t, diff = r[: len(cols)], r[-2], r[-1]
             if pks:
                 key = int(row_keys([np.asarray([values[cols.index(pk)]], dtype=object) for pk in pks], n=1)[0])
             else:
-                key = int(sequential_keys(i, 1)[0])
+                key = int(seq_keys[i])
             events.append((int(t), key, tuple(values), int(diff)))
         events.sort(key=lambda e: e[0])
         return read_subject(_StaticStreamSubject(events, cols), schema=schema)

@@ -167,6 +167,33 @@ def make_column(values: list, np_dtype: np.dtype) -> np.ndarray:
         return arr
 
 
+def concat_cols(parts: list[np.ndarray]) -> np.ndarray:
+    """Concatenate column arrays; mixed dtypes merge into an object array.
+    list() keeps datetime64/timedelta64 scalars intact (direct slice-assign
+    into an object array int-ifies them)."""
+    if len(parts) == 1:
+        return parts[0]
+    if all(p.dtype == parts[0].dtype for p in parts):
+        return np.concatenate(parts)
+    merged = np.empty(sum(len(p) for p in parts), dtype=object)
+    ofs = 0
+    for p in parts:
+        merged[ofs : ofs + len(p)] = list(p) if p.dtype.kind in ("M", "m") else p
+        ofs += len(p)
+    return merged
+
+
+def group_starts(sorted_keys: np.ndarray) -> np.ndarray:
+    """Boundary indices of equal-key runs in a sorted key array."""
+    n = len(sorted_keys)
+    if n == 0:
+        return np.empty(0, dtype=np.int64)
+    boundaries = np.empty(n, dtype=bool)
+    boundaries[0] = True
+    boundaries[1:] = sorted_keys[1:] != sorted_keys[:-1]
+    return np.flatnonzero(boundaries)
+
+
 def concat_batches(batches: list[DeltaBatch]) -> DeltaBatch | None:
     batches = [b for b in batches if not b.is_empty]
     if not batches:
@@ -177,22 +204,7 @@ def concat_batches(batches: list[DeltaBatch]) -> DeltaBatch | None:
     keys = np.concatenate([b.keys for b in batches])
     diffs = np.concatenate([b.diffs for b in batches])
     names = batches[0].data.keys()
-    data = {}
-    for n in names:
-        cols = [b.data[n] for b in batches]
-        if all(c.dtype == cols[0].dtype for c in cols):
-            data[n] = np.concatenate(cols)
-        else:
-            merged = np.empty(len(keys), dtype=object)
-            ofs = 0
-            for c in cols:
-                # list() keeps datetime64/timedelta64 scalars intact (direct
-                # slice-assign into an object array int-ifies them)
-                merged[ofs : ofs + len(c)] = (
-                    list(c) if c.dtype.kind in ("M", "m") else c
-                )
-                ofs += len(c)
-            data[n] = merged
+    data = {n: concat_cols([b.data[n] for b in batches]) for n in names}
     return DeltaBatch(keys, diffs, data, time)
 
 

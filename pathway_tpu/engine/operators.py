@@ -12,7 +12,6 @@ All state lives keyed by uint64 row keys, diffs are ±weights.
 from __future__ import annotations
 
 import threading
-from collections import defaultdict
 from typing import Any, Callable, Iterable
 
 import numpy as np
@@ -21,9 +20,12 @@ from pathway_tpu.engine.blocks import (
     DeltaBatch,
     column_to_list,
     concat_batches,
+    concat_cols,
     consolidate,
+    group_starts,
     make_column,
 )
+from pathway_tpu.engine.colstore import ColumnarMultimap, SortedCounts
 from pathway_tpu.engine.graph import END_OF_STREAM, SOLO, Node
 from pathway_tpu.engine.reducers_impl import ReducerImpl
 from pathway_tpu.internals.keys import combine_keys, row_keys, splitmix64
@@ -326,6 +328,11 @@ class GroupByNode(Node):
         # first-load fast path: per-group partials parked as arrays; folded into
         # the dict state only if incremental deltas arrive later
         self._archived: list[dict] = []
+        # fully-columnar state (sorted gk → n/accumulator/group-value arrays):
+        # active while every reducer is additive-columnar and every batch's
+        # aggregated columns are numeric; falls back to the dict path otherwise
+        self.use_dict = not all(spec[1].columnar for spec in reducer_specs)
+        self.cstate: dict | None = None
 
     GLOBAL_KEY = 0x6A09E667F3BCC908  # single group for global reduce()
 
@@ -353,10 +360,7 @@ class GroupByNode(Node):
         gkeys = self._gkeys(batch)
         order = np.argsort(gkeys, kind="stable")
         gk_sorted = gkeys[order]
-        boundaries = np.empty(len(gk_sorted), dtype=bool)
-        boundaries[0] = True
-        boundaries[1:] = gk_sorted[1:] != gk_sorted[:-1]
-        starts = np.flatnonzero(boundaries)
+        starts = group_starts(gk_sorted)
         diffs = batch.diffs
         counts = np.add.reduceat(diffs[order], starts)
         partials: list[Any] = []
@@ -430,10 +434,162 @@ class GroupByNode(Node):
                     del self.state[gk]
         self._archived = []
 
+    def _process_columnar(self, batch: DeltaBatch, time: int) -> list[DeltaBatch] | None:
+        """Whole-state vectorized aggregation: state is sorted arrays, a delta
+        block merges in with searchsorted + reduceat; no per-group Python.
+        Returns None when this batch's columns can't vectorize (→ dict path)."""
+        gkeys = self._gkeys(batch)
+        order = np.argsort(gkeys, kind="stable")
+        gk_sorted = gkeys[order]
+        starts = group_starts(gk_sorted)
+        diffs = batch.diffs
+        partials: list[np.ndarray] = []
+        for (_, impl, cols) in self.reducer_specs:
+            arrays = [batch.data[c] for c in cols]
+            p = impl.grouped_partials_np(arrays, diffs, order, starts)
+            if p is None:
+                return None
+            partials.append(p)
+        u_gk = gk_sorted[starts]
+        counts = np.add.reduceat(diffs[order], starts)
+        first_rows = order[starts]
+        batch_gcols = [batch.data[c][first_rows] for c in self.group_cols]
+
+        st = self.cstate
+        if st is None:
+            st = self.cstate = {
+                "gk": np.empty(0, dtype=np.uint64),
+                "n": np.empty(0, dtype=np.int64),
+                "accs": [np.empty(0, dtype=p.dtype) for p in partials],
+                "gcols": [a[:0] for a in batch_gcols],
+            }
+        sgk = st["gk"]
+        if len(sgk):
+            pos = np.searchsorted(sgk, u_gk).clip(0, len(sgk) - 1)
+            exists = sgk[pos] == u_gk
+        else:
+            pos = np.zeros(len(u_gk), dtype=np.int64)
+            exists = np.zeros(len(u_gk), dtype=bool)
+        old_n = np.where(exists, st["n"][pos] if len(sgk) else 0, 0)
+        new_n = old_n + counts
+        old_accs: list[np.ndarray] = []
+        new_accs: list[np.ndarray] = []
+        for acc_arr, p in zip(st["accs"], partials):
+            dt = np.result_type(acc_arr.dtype, p.dtype)
+            old = np.zeros(len(u_gk), dtype=dt)
+            if len(acc_arr):
+                ex = np.flatnonzero(exists)
+                old[ex] = acc_arr[pos[ex]]
+            old_accs.append(old)
+            new_accs.append(old + p)
+
+        # emission: retract the previously-emitted aggregate of every changed
+        # group, emit the new one (None-id group excluded, see on_end)
+        not_none = u_gk != np.uint64(self.NONE_KEY)
+        was = exists & (old_n > 0) & not_none
+        now = (new_n > 0) & not_none
+        changed = np.zeros(len(u_gk), dtype=bool)
+        for old, new in zip(old_accs, new_accs):
+            changed |= old != new
+        emit_retract = was & (~now | changed)
+        emit_insert = now & (~was | changed)
+
+        # group-col values: the state's first-seen copy for existing groups,
+        # the batch's for new groups
+        g_out: list[np.ndarray] = []
+        for sc, bc in zip(st["gcols"], batch_gcols):
+            if not len(sc):
+                g_out.append(bc)
+                continue
+            ex = np.flatnonzero(exists)
+            if sc.dtype == bc.dtype:
+                merged = bc.copy()
+                merged[ex] = sc[pos[ex]]
+            else:
+                merged = np.empty(len(u_gk), dtype=object)
+                merged[:] = list(bc) if bc.dtype.kind in ("M", "m") else bc
+                picked = sc[pos[ex]]
+                merged[ex] = list(picked) if sc.dtype.kind in ("M", "m") else picked
+            g_out.append(merged)
+
+        # update state: in-place for surviving groups, rebuild for add/remove
+        remove = exists & (new_n <= 0)
+        add = ~exists & (new_n > 0)
+        upd = exists & (new_n > 0)
+        if upd.any():
+            ui = pos[upd]
+            st["n"][ui] = new_n[upd]
+            for r in range(len(st["accs"])):
+                vals = new_accs[r][upd]
+                if st["accs"][r].dtype != vals.dtype:
+                    st["accs"][r] = st["accs"][r].astype(
+                        np.result_type(st["accs"][r].dtype, vals.dtype)
+                    )
+                st["accs"][r][ui] = vals
+        if remove.any() or add.any():
+            keep = np.ones(len(sgk), dtype=bool)
+            keep[pos[remove]] = False
+            gk2 = np.concatenate([sgk[keep], u_gk[add]])
+            o2 = np.argsort(gk2, kind="stable")
+            st["gk"] = gk2[o2]
+            st["n"] = np.concatenate([st["n"][keep], new_n[add]])[o2]
+            for r in range(len(st["accs"])):
+                a, b = st["accs"][r][keep], new_accs[r][add]
+                dt = np.result_type(a.dtype, b.dtype)
+                st["accs"][r] = np.concatenate(
+                    [a.astype(dt, copy=False), b.astype(dt, copy=False)]
+                )[o2]
+            st["gcols"] = [
+                concat_cols([sc[keep], bc[add]])[o2]
+                for sc, bc in zip(st["gcols"], batch_gcols)
+            ]
+
+        r_idx = np.flatnonzero(emit_retract)
+        i_idx = np.flatnonzero(emit_insert)
+        if not len(r_idx) and not len(i_idx):
+            return []
+        keys_out = np.concatenate([u_gk[r_idx], u_gk[i_idx]])
+        diffs_out = np.concatenate(
+            [np.full(len(r_idx), -1, dtype=np.int64), np.ones(len(i_idx), dtype=np.int64)]
+        )
+        data: dict[str, np.ndarray] = {}
+        for name, col in zip(self.out_group_cols, g_out):
+            data[name] = concat_cols([col[r_idx], col[i_idx]])
+        for r, (name, _, _) in enumerate(self.reducer_specs):
+            data[name] = np.concatenate([old_accs[r][r_idx], new_accs[r][i_idx]])
+        return [DeltaBatch(keys_out, diffs_out, data, time)]
+
+    def _decolumnarize(self) -> None:
+        """A batch arrived that the columnar path can't aggregate (object
+        column): convert the array state to dict state and stay there."""
+        self.use_dict = True
+        st = self.cstate
+        self.cstate = None
+        if st is None:
+            return
+        gk_list = st["gk"].tolist()
+        n_list = st["n"].tolist()
+        gcol_lists = [column_to_list(c) for c in st["gcols"]]
+        acc_lists = [a.tolist() for a in st["accs"]]
+        for i, gk in enumerate(gk_list):
+            g_tuple = tuple(col[i] for col in gcol_lists)
+            accs = [acc_lists[r][i] for r in range(len(acc_lists))]
+            emitted = None
+            if n_list[i] > 0 and gk != self.NONE_KEY:
+                emitted = g_tuple[: len(self.out_group_cols)] + tuple(accs)
+            self.state[gk] = {
+                "g": g_tuple, "acc": accs, "n": n_list[i], "emitted": emitted,
+            }
+
     def process(self, inputs, time):
         batch = inputs[0]
-        if batch is None:
+        if batch is None or not len(batch):
             return []
+        if not self.use_dict:
+            res = self._process_columnar(batch, time)
+            if res is not None:
+                return res
+            self._decolumnarize()
         if not self.state and len(batch) and bool((batch.diffs > 0).all()):
             if all(spec[1].semigroup for spec in self.reducer_specs) and not self._archived:
                 fast = self._vector_first_load(batch, time)
@@ -444,11 +600,7 @@ class GroupByNode(Node):
         gkeys = self._gkeys(batch)
         order = np.argsort(gkeys, kind="stable")
         gk_sorted = gkeys[order]
-        boundaries = np.empty(len(gk_sorted), dtype=bool)
-        if len(gk_sorted):
-            boundaries[0] = True
-            boundaries[1:] = gk_sorted[1:] != gk_sorted[:-1]
-        starts = np.flatnonzero(boundaries)
+        starts = group_starts(gk_sorted)
         ends = np.append(starts[1:], len(gk_sorted))
 
         group_arrays = [batch.data[c] for c in self.group_cols]
@@ -554,12 +706,19 @@ class GroupByNode(Node):
         # genuinely-None id-expression and were excluded from output — say so
         # instead of losing them silently (reference routes error-keyed rows to
         # the error log)
+        n_none = 0
         st = self.state.get(self.NONE_KEY)
-        if st is not None and st["n"] > 0:
+        if st is not None:
+            n_none = st["n"]
+        elif self.cstate is not None and len(self.cstate["gk"]):
+            pos = int(np.searchsorted(self.cstate["gk"], np.uint64(self.NONE_KEY)))
+            if pos < len(self.cstate["gk"]) and self.cstate["gk"][pos] == np.uint64(self.NONE_KEY):
+                n_none = int(self.cstate["n"][pos])
+        if n_none > 0:
             import warnings
 
             warnings.warn(
-                f"groupby: {st['n']} row(s) with a None grouping id were "
+                f"groupby: {n_none} row(s) with a None grouping id were "
                 "excluded from the output",
                 stacklevel=2,
             )
@@ -677,12 +836,15 @@ class CombineNode(Node):
 class JoinNode(Node):
     """Incremental symmetric hash equi-join with outer padding.
 
-    The block counterpart of ``join_tables`` (``src/engine/graph.rs:783`` region):
-    per-side state maps join-key → {row_key → values}; a delta on one side joins
-    against the other side's state. For outer variants, per join-key match counts
-    decide when unmatched (null-padded) rows appear/disappear; output row keys are
-    ``hash(left_key, right_key)`` (padded rows: hash with a side salt), matching the
-    reference's id-from-both-sides discipline.
+    The block counterpart of ``join_tables`` (``src/engine/graph.rs:783`` region),
+    with state held the way differential holds arrangements — columnar and sorted
+    (``engine/colstore.py``) — so every delta block, first load or late-stream,
+    is probed and applied with searchsorted/repeat-expansion kernels; there is no
+    per-row dict path at all. For outer variants, a ``SortedCounts`` per side
+    tracks live-row counts per join key; its batch 0↔+ transitions drive padded
+    (null-extended) row flips. Output row keys are ``hash(left_key, right_key)``
+    (padded rows: hash with a side salt), matching the reference's
+    id-from-both-sides discipline.
     """
 
     name = "join"
@@ -712,7 +874,6 @@ class JoinNode(Node):
         how: str = "inner",  # inner | left | right | outer
         out_columns: list[str] | None = None,
         left_id_only: bool = False,
-        np_dtypes: dict | None = None,
     ):
         super().__init__(n_inputs=2)
         self.left_cols = left_cols
@@ -724,14 +885,12 @@ class JoinNode(Node):
         self.out_columns = out_columns or (
             ["__left_id__", "__right_id__"] + left_cols + right_cols
         )
-        self.np_dtypes = np_dtypes or {}
-        # jk -> {row_key -> values}
-        self.state: list[dict[int, dict[int, tuple]]] = [defaultdict(dict), defaultdict(dict)]
-        # first-load fast path: batches joined vectorized and parked here; they
-        # are folded into the dict state only if incremental deltas arrive later
-        self._archived: list[list[DeltaBatch]] = [[], []]
+        # columnar per-side state: sorted segments of (jk, rk, values)
+        self.store = [ColumnarMultimap(len(left_cols)), ColumnarMultimap(len(right_cols))]
+        # per-side live-row counts per jk (outer padding only)
+        self.jk_counts = [SortedCounts(), SortedCounts()]
 
-    # ---------------------------------------------------- vectorized first load
+    # -------------------------------------------------------------- block kernels
 
     def _jk_valid(self, batch: DeltaBatch, side: int) -> tuple[np.ndarray, np.ndarray]:
         col = batch.data[self.left_on if side == 0 else self.right_on]
@@ -757,261 +916,148 @@ class JoinNode(Node):
             self.out_columns[2 + nl :],
         )
 
-    def _pad_batch(self, batch: DeltaBatch, idx: np.ndarray, side: int, time: int) -> DeltaBatch:
-        """Null-padded output rows for unmatched rows ``idx`` of ``batch``."""
+    def _pad_arrays(
+        self,
+        side: int,
+        rk: np.ndarray,
+        cols: list[np.ndarray],
+        diffs: np.ndarray,
+        time: int,
+    ) -> DeltaBatch:
+        """Null-padded output rows for unmatched rows of ``side``."""
         lid, rid, l_names, r_names = self._out_col_names()
-        keys_side = batch.keys[idx]
         if side == 0:
-            out_keys = keys_side if self.left_id_only else splitmix64(keys_side ^ np.uint64(0xA0B0))
+            out_keys = rk if self.left_id_only else splitmix64(rk ^ np.uint64(0xA0B0))
         else:
-            out_keys = splitmix64(keys_side ^ np.uint64(0xB0A0))
-        none_col = np.full(len(idx), None, dtype=object)
+            out_keys = splitmix64(rk ^ np.uint64(0xB0A0))
+        none_col = np.full(len(rk), None, dtype=object)
         data: dict[str, np.ndarray] = {}
-        data[lid] = keys_side if side == 0 else none_col
-        data[rid] = keys_side if side == 1 else none_col
+        data[lid] = rk if side == 0 else none_col
+        data[rid] = rk if side == 1 else none_col
         my_names = l_names if side == 0 else r_names
         other_names = r_names if side == 0 else l_names
-        for name, src in zip(my_names, self._side_cols(side)):
-            data[name] = batch.data[src][idx]
+        for name, arr in zip(my_names, cols):
+            data[name] = arr
         for name in other_names:
             data[name] = none_col
-        return DeltaBatch(out_keys, batch.diffs[idx], data, time)
+        return DeltaBatch(out_keys, diffs.astype(np.int64), data, time)
 
-    def _vector_first_load(
-        self, lb: DeltaBatch | None, rb: DeltaBatch | None, time: int
-    ) -> list[DeltaBatch]:
+    def _matched_arrays(
+        self,
+        side: int,
+        my_rk: np.ndarray,
+        my_cols: list[np.ndarray],
+        o_rk: np.ndarray,
+        o_cols: list[np.ndarray],
+        diffs: np.ndarray,
+        time: int,
+    ) -> DeltaBatch:
+        """Matched output rows: ``side``'s delta rows × the other side's state."""
         lid, rid, l_names, r_names = self._out_col_names()
-        out: list[DeltaBatch] = []
-        l_pad = self.how in ("left", "outer")
-        r_pad = self.how in ("right", "outer")
-
-        if lb is not None and rb is not None and len(lb) and len(rb):
-            l_jk, l_valid = self._jk_valid(lb, 0)
-            r_jk, r_valid = self._jk_valid(rb, 1)
-            lv = np.flatnonzero(l_valid)
-            rv = np.flatnonzero(r_valid)
-            r_order = rv[np.argsort(r_jk[rv], kind="stable")]
-            r_sorted = r_jk[r_order]
-            uniq, u_start, u_count = np.unique(r_sorted, return_index=True, return_counts=True)
-            if len(uniq):
-                pos = np.searchsorted(uniq, l_jk[lv]).clip(0, len(uniq) - 1)
-                has = uniq[pos] == l_jk[lv]
-            else:
-                pos = np.zeros(len(lv), dtype=np.int64)
-                has = np.zeros(len(lv), dtype=bool)
-            ml = lv[has]
-            cnt = u_count[pos[has]]
-            total = int(cnt.sum())
-            if total:
-                lexp = np.repeat(ml, cnt)
-                starts_ = u_start[pos[has]]
-                csum = np.cumsum(cnt) - cnt
-                ofs = np.repeat(starts_, cnt) + np.arange(total) - np.repeat(csum, cnt)
-                rexp = r_order[ofs]
-                lk = lb.keys[lexp]
-                rk = rb.keys[rexp]
-                out_keys = lk if self.left_id_only else combine_keys(lk, rk)
-                data: dict[str, np.ndarray] = {lid: lk, rid: rk}
-                for name, src in zip(l_names, self.left_cols):
-                    data[name] = lb.data[src][lexp]
-                for name, src in zip(r_names, self.right_cols):
-                    data[name] = rb.data[src][rexp]
-                out.append(
-                    DeltaBatch(out_keys, lb.diffs[lexp] * rb.diffs[rexp], data, time)
-                )
-            if l_pad:
-                lpad_idx = np.concatenate([lv[~has], np.flatnonzero(~l_valid)])
-                if len(lpad_idx):
-                    out.append(self._pad_batch(lb, lpad_idx, 0, time))
-            if r_pad:
-                uniq_l = np.unique(l_jk[lv])
-                if len(uniq_l):
-                    rpos = np.searchsorted(uniq_l, r_jk[rv]).clip(0, len(uniq_l) - 1)
-                    rhas = uniq_l[rpos] == r_jk[rv]
-                else:
-                    rhas = np.zeros(len(rv), dtype=bool)
-                rpad_idx = np.concatenate([rv[~rhas], np.flatnonzero(~r_valid)])
-                if len(rpad_idx):
-                    out.append(self._pad_batch(rb, rpad_idx, 1, time))
+        if side == 0:
+            lk, rk, l_cols, r_cols = my_rk, o_rk, my_cols, o_cols
         else:
-            single = lb if lb is not None and len(lb) else rb
-            side = 0 if single is lb else 1
-            if single is not None and len(single):
-                if (side == 0 and l_pad) or (side == 1 and r_pad):
-                    out.append(self._pad_batch(single, np.arange(len(single)), side, time))
+            lk, rk, l_cols, r_cols = o_rk, my_rk, o_cols, my_cols
+        out_keys = lk if self.left_id_only else combine_keys(lk, rk)
+        data: dict[str, np.ndarray] = {lid: lk, rid: rk}
+        for name, arr in zip(l_names, l_cols):
+            data[name] = arr
+        for name, arr in zip(r_names, r_cols):
+            data[name] = arr
+        return DeltaBatch(out_keys, diffs.astype(np.int64), data, time)
 
-        for side, b in ((0, lb), (1, rb)):
-            if b is not None and len(b):
-                self._archived[side].append(b)
+    def _apply_side(self, side: int, batch: DeltaBatch, time: int) -> list[DeltaBatch]:
+        """Apply one side's delta block against the other side's columnar state."""
+        jk, valid = self._jk_valid(batch, side)
+        diffs = batch.diffs
+        my_cols = [batch.data[c] for c in self._side_cols(side)]
+        pad_mine = self.how in ("left", "outer") if side == 0 else self.how in ("right", "outer")
+        pad_other = self.how in ("right", "outer") if side == 0 else self.how in ("left", "outer")
+        other = self.store[1 - side]
+        out: list[DeltaBatch] = []
+        # null join keys never match; padded if outer on my side
+        if pad_mine and not valid.all():
+            inv = np.flatnonzero(~valid)
+            out.append(
+                self._pad_arrays(
+                    side, batch.keys[inv], [c[inv] for c in my_cols], diffs[inv], time
+                )
+            )
+        for sign in (-1, 1):  # retractions before insertions
+            idx = np.flatnonzero(valid & ((diffs < 0) if sign < 0 else (diffs > 0)))
+            if not len(idx):
+                continue
+            q_jk = jk[idx]
+            q_rk = batch.keys[idx]
+            q_diff = diffs[idx]
+            q_cols = [c[idx] for c in my_cols]
+            # matched rows appear/disappear with my delta's sign
+            m_q, m_rk, m_cols = other.match(q_jk)
+            if len(m_q):
+                out.append(
+                    self._matched_arrays(
+                        side, q_rk[m_q], [c[m_q] for c in q_cols],
+                        m_rk, m_cols, q_diff[m_q], time,
+                    )
+                )
+            # my padded rows exist exactly while the other side has no match
+            if pad_mine:
+                unmatched = np.flatnonzero(self.jk_counts[1 - side].get(q_jk) == 0)
+                if len(unmatched):
+                    out.append(
+                        self._pad_arrays(
+                            side, q_rk[unmatched],
+                            [c[unmatched] for c in q_cols], q_diff[unmatched], time,
+                        )
+                    )
+            # apply my delta to my state; 0<->+ transitions flip the other
+            # side's padded rows
+            if self.how == "inner":
+                if sign < 0:
+                    self.store[side].delete(q_jk, q_rk)
+                else:
+                    self.store[side].insert(q_jk, q_rk, q_cols)
+                continue
+            uniq, prev, new = self.jk_counts[side].add(q_jk, q_diff)
+            if sign < 0:
+                self.store[side].delete(q_jk, q_rk)
+                flipped = uniq[(prev > 0) & (new <= 0)]
+                flip_diff = 1  # other side lost its last match: padded rows appear
+            else:
+                self.store[side].insert(q_jk, q_rk, q_cols)
+                flipped = uniq[(prev <= 0) & (new > 0)]
+                flip_diff = -1  # other side gained a first match: padded rows retract
+            if pad_other and len(flipped):
+                f_q, f_rk, f_cols = other.match(flipped)
+                if len(f_q):
+                    out.append(
+                        self._pad_arrays(
+                            1 - side, f_rk, f_cols,
+                            np.full(len(f_rk), flip_diff, dtype=np.int64), time,
+                        )
+                    )
         return out
 
-    def _materialize_archived(self) -> None:
-        """Fold parked first-load batches into the dict state so the per-row
-        incremental path sees them."""
-        for side, batches in enumerate(self._archived):
-            my_state = self.state[side]
-            for b in batches:
-                jk_arr, valid = self._jk_valid(b, side)
-                jks = jk_arr.tolist()
-                rks = b.keys.tolist()
-                val_lists = [column_to_list(b.data[c]) for c in self._side_cols(side)]
-                rows_l = list(zip(*val_lists)) if val_lists else [()] * len(b)
-                vmask = valid.tolist()
-                for i in range(len(rks)):
-                    if vmask[i]:
-                        my_state[jks[i]][rks[i]] = rows_l[i]
-        self._archived = [[], []]
-
-    def _pad(self, side: int) -> tuple:
-        """None-padding for the other side's columns."""
-        n = len(self.right_cols) if side == 0 else len(self.left_cols)
-        return tuple([None] * n)
-
     def process(self, inputs, time):
-        # First load (no prior state, pure insertions): join the two batches
-        # vectorized — searchsorted matching, repeat-expansion of multi-matches —
-        # and park them; dict state is only built if incremental deltas follow.
-        lb, rb = inputs[0], inputs[1]
-        if not self.state[0] and not self.state[1] and not self._archived[0] and not self._archived[1]:
-            all_pos = all(
-                b is None or len(b) == 0 or bool((b.diffs > 0).all()) for b in (lb, rb)
-            )
-            if all_pos:
-                return self._vector_first_load(lb, rb, time)
-        if self._archived[0] or self._archived[1]:
-            self._materialize_archived()
-        # Emission is collected in three categories so output keys are computed
-        # in ONE vectorized pass at the end (combine_keys over arrays), instead
-        # of hashing 1-element arrays per matched pair.
-        m_lk: list[int] = []   # matched: left row key
-        m_rk: list[int] = []   # matched: right row key
-        m_diff: list[int] = []
-        m_row: list[tuple] = []
-        lp_k: list[int] = []   # left-padded (left row, no right match)
-        lp_diff: list[int] = []
-        lp_row: list[tuple] = []
-        rp_k: list[int] = []   # right-padded
-        rp_diff: list[int] = []
-        rp_row: list[tuple] = []
-
-        pad0 = self._pad(0)
-        pad1 = self._pad(1)
-
-        def emit_matched(lk, lrow, rk, rrow, diff):
-            m_lk.append(lk)
-            m_rk.append(rk)
-            m_diff.append(diff)
-            m_row.append((lk, rk) + lrow + rrow)
-
-        def emit_left_pad(lk, lrow, diff):
-            lp_k.append(lk)
-            lp_diff.append(diff)
-            lp_row.append((lk, None) + lrow + pad0)
-
-        def emit_right_pad(rk, rrow, diff):
-            rp_k.append(rk)
-            rp_diff.append(diff)
-            rp_row.append((None, rk) + pad1 + rrow)
-
+        # Sides apply sequentially (left first), each probing the other's
+        # state as of that moment — the batch-granular equivalent of the
+        # reference's record-at-a-time symmetric join discipline.
+        out: list[DeltaBatch] = []
         for side in (0, 1):
             batch = inputs[side]
-            if batch is None:
-                continue
-            my_state = self.state[side]
-            other_state = self.state[1 - side]
-            on_raw = batch.data[self.left_on if side == 0 else self.right_on]
-            # python-native lists: scalar access is far cheaper than numpy boxing
-            if on_raw.dtype == object:
-                jks = [None if v is None else int(v) for v in on_raw]
-            else:
-                jks = on_raw.astype(np.uint64).tolist()
-            rks = batch.keys.tolist()
-            diffs_l = batch.diffs.tolist()
-            val_lists = [
-                column_to_list(batch.data[c])
-                for c in (self.left_cols if side == 0 else self.right_cols)
-            ]
-            rows_l = list(zip(*val_lists)) if val_lists else [()] * len(batch)
-            pad_mine = self.how in ("left", "outer") if side == 0 else self.how in ("right", "outer")
-            pad_other = self.how in ("right", "outer") if side == 0 else self.how in ("left", "outer")
-            for i in range(len(rks)):
-                jk = jks[i]
-                rk = rks[i]
-                row = rows_l[i]
-                diff = diffs_l[i]
-                if jk is None:
-                    # null join keys never match; padded if outer on my side
-                    if pad_mine:
-                        if side == 0:
-                            emit_left_pad(rk, row, diff)
-                        else:
-                            emit_right_pad(rk, row, diff)
-                    continue
-                mine = my_state[jk]
-                others = other_state[jk] if jk in other_state else {}
-                n_other = len(others)
-                n_mine_before = len(mine)
-                if diff > 0:
-                    mine[rk] = row
-                else:
-                    mine.pop(rk, None)
-                    if not mine:
-                        del my_state[jk]
-                # matched outputs
-                if others:
-                    if side == 0:
-                        for ok, orow in others.items():
-                            emit_matched(rk, row, ok, orow, diff)
-                    else:
-                        for ok, orow in others.items():
-                            emit_matched(ok, orow, rk, row, diff)
-                # my padded row when no match on the other side
-                if pad_mine and n_other == 0:
-                    if side == 0:
-                        emit_left_pad(rk, row, diff)
-                    else:
-                        emit_right_pad(rk, row, diff)
-                # other side's padded rows flip when my count transitions 0<->+
-                if pad_other:
-                    n_mine_after = n_mine_before + (1 if diff > 0 else -1)
-                    if n_mine_before == 0 and n_mine_after == 1:
-                        for ok, orow in others.items():
-                            if side == 0:
-                                emit_right_pad(ok, orow, -1)
-                            else:
-                                emit_left_pad(ok, orow, -1)
-                    elif n_mine_before == 1 and n_mine_after == 0:
-                        for ok, orow in others.items():
-                            if side == 0:
-                                emit_right_pad(ok, orow, +1)
-                            else:
-                                emit_left_pad(ok, orow, +1)
-
-        n_out = len(m_lk) + len(lp_k) + len(rp_k)
-        if n_out == 0:
+            if batch is not None and len(batch):
+                out.extend(self._apply_side(side, batch, time))
+        out = [b for b in out if not b.is_empty]
+        if not out:
             return []
-        key_parts: list[np.ndarray] = []
-        if m_lk:
-            lk_arr = np.array(m_lk, dtype=np.uint64)
-            if self.left_id_only:
-                key_parts.append(lk_arr)
-            else:
-                key_parts.append(combine_keys(lk_arr, np.array(m_rk, dtype=np.uint64)))
-        if lp_k:
-            lp_arr = np.array(lp_k, dtype=np.uint64)
-            if self.left_id_only:
-                key_parts.append(lp_arr)
-            else:
-                key_parts.append(splitmix64(lp_arr ^ np.uint64(0xA0B0)))
-        if rp_k:
-            key_parts.append(splitmix64(np.array(rp_k, dtype=np.uint64) ^ np.uint64(0xB0A0)))
-        keys = np.concatenate(key_parts)
-        diffs = np.array(m_diff + lp_diff + rp_diff, dtype=np.int64)
-        rows = m_row + lp_row + rp_row
-        batch = DeltaBatch.from_rows(
-            keys.tolist(), rows, self.out_columns, time, diffs=diffs, np_dtypes=self.np_dtypes
-        )
-        return [consolidate(batch)]
+        if len(out) == 1:
+            # every batch _apply_side emits is sign-pure (per-sign sub-batches,
+            # flips are constant-diff), so a lone batch cannot net against itself
+            return out
+        merged = concat_batches(out)
+        if merged is None:
+            return []
+        return [consolidate(merged)]
 
 
 # ---------------------------------------------------------------------------- outputs

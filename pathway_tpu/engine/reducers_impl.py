@@ -52,9 +52,26 @@ class ReducerImpl:
         partial per group, or None to fall back to per-group ``batch_partial``."""
         return None
 
+    #: accumulator representable as a flat numeric array, merged by addition —
+    #: lets GroupByNode keep its whole state columnar (no per-group Python)
+    columnar = False
+
+    def grouped_partials_np(
+        self,
+        cols: list[np.ndarray],
+        diffs: np.ndarray,
+        order: np.ndarray,
+        starts: np.ndarray,
+    ) -> np.ndarray | None:
+        """Columnar variant of ``grouped_partials``: one numeric array with a
+        partial per group, or None when this batch's columns can't vectorize
+        (object dtype)."""
+        return None
+
 
 class CountReducer(ReducerImpl):
     semigroup = True
+    columnar = True
 
     def make(self):
         return 0
@@ -74,9 +91,13 @@ class CountReducer(ReducerImpl):
     def grouped_partials(self, cols, diffs, order, starts):
         return np.add.reduceat(diffs[order], starts).tolist()
 
+    def grouped_partials_np(self, cols, diffs, order, starts):
+        return np.add.reduceat(diffs[order], starts)
+
 
 class SumReducer(ReducerImpl):
     semigroup = True
+    columnar = True
 
     def __init__(self, kind: str = "int"):
         self.kind = kind
@@ -113,6 +134,16 @@ class SumReducer(ReducerImpl):
             return None
         weighted = col[order] * diffs[order]
         return np.add.reduceat(weighted, starts).tolist()
+
+    def grouped_partials_np(self, cols, diffs, order, starts):
+        col = cols[0]
+        if col.dtype == object or col.dtype.kind not in "iufb":
+            return None
+        weighted = col[order] * diffs[order]
+        out = np.add.reduceat(weighted, starts)
+        if self.kind == "float" and out.dtype.kind != "f":
+            out = out.astype(np.float64)
+        return out
 
 
 class ArraySumReducer(ReducerImpl):

@@ -13,7 +13,6 @@ from __future__ import annotations
 
 from typing import Any
 
-import numpy as np
 
 from pathway_tpu.engine import operators as ops
 from pathway_tpu.internals import dtype as dt
@@ -106,15 +105,6 @@ class JoinResult:
         for n in r_cols:
             d = right._schema.dtypes()[n]
             dtypes[f"__r__{n}"] = dt.Optional(d) if r_opt else d
-        # storage dtypes keep join-output columns numeric where possible so
-        # downstream hashing/consolidation stays on vectorized paths; columns
-        # that can be None-padded stay object so None is preserved (a float64
-        # column would silently turn pad-None into NaN and break retraction
-        # matching against the fast path's object pads)
-        out_np_dtypes = {
-            c: (np.dtype(object) if isinstance(d, dt.Optional) else d.np_dtype)
-            for c, d in dtypes.items()
-        }
         node = LogicalNode(
             lambda: ops.JoinNode(
                 left_cols=[f"__v_{n}" for n in l_cols],
@@ -124,7 +114,6 @@ class JoinResult:
                 how=how,
                 out_columns=out_columns,
                 left_id_only=left_id_only,
-                np_dtypes=out_np_dtypes,
             ),
             [pre_l._node, pre_r._node],
             name=f"join_{how}",

@@ -140,3 +140,77 @@ def test_join_datetime_value_through_first_load():
     rows = sorted(rows_of(j).elements(), key=str)
     assert rows == [(7, d1), (8, None)], rows
     assert isinstance(rows[0][1], np.datetime64)
+
+
+def test_outer_join_streaming_padding_flips_match_static():
+    """Columnar incremental outer join: padded rows must flip correctly as
+    matches appear and disappear across timestamps."""
+    rows = [
+        # (k, v, time, diff) left  /  (k, w, time, diff) right
+        ("l", 1, 10, 0, 1),
+        ("r", 2, 20, 0, 1),
+        ("l", 2, 11, 2, 1),   # right 2 exists -> match
+        ("l", 3, 12, 2, 1),   # unmatched -> left pad
+        ("r", 3, 30, 4, 1),   # left 3 now matched: pad flips
+        ("r", 2, 20, 6, -1),  # right 2 retracted: left 2 pad reappears
+        ("l", 1, 10, 8, -1),  # left 1 retracted: right-side... (left pad gone)
+    ]
+    def md(side, vcol):
+        lines = [f"k | {vcol} | __time__ | __diff__"]
+        lines += [
+            f"{k} | {v} | {t} | {d}" for (s, k, v, t, d) in rows if s == side
+        ]
+        return "\n".join(lines)
+
+    def build(stream: bool):
+        ls = pw.schema_from_types(k=int, v=int)
+        rs = pw.schema_from_types(k=int, w=int)
+        if stream:
+            left = pw.debug.table_from_markdown(md("l", "v"))
+            right = pw.debug.table_from_markdown(md("r", "w"))
+        else:
+            # net rows after all diffs
+            left = pw.debug.table_from_rows(ls, [(2, 11), (3, 12)])
+            right = pw.debug.table_from_rows(rs, [(3, 30)])
+        j = left.join_outer(right, left.k == right.k).select(
+            k=pw.coalesce(left.k, right.k), v=left.v, w=right.w
+        )
+        return rows_of(j)
+
+    assert build(stream=True) == build(stream=False)
+
+
+def test_groupby_columnar_streaming_matches_static():
+    stream = [
+        (1, 5, 0, 1),
+        (2, 7, 0, 1),
+        (1, 3, 2, 1),
+        (1, 5, 4, -1),   # retraction updates sum+count
+        (2, 7, 6, -1),   # group 2 disappears entirely
+        (3, 9, 6, 1),
+    ]
+    sch = pw.schema_from_types(k=int, v=int)
+    t = pw.debug.table_from_rows(sch, stream, is_stream=True)
+    g = t.groupby(t.k).reduce(t.k, s=pw.reducers.sum(t.v), c=pw.reducers.count())
+    assert rows_of(g) == rows_of(
+        pw.debug.table_from_rows(sch, [(1, 3), (3, 9)])
+        .groupby(pw.this.k)
+        .reduce(pw.this.k, s=pw.reducers.sum(pw.this.v), c=pw.reducers.count())
+    )
+
+
+def test_groupby_decolumnarize_on_object_column():
+    """Sum over a column that goes object-typed mid-stream must fall back to
+    the dict path without losing accumulated state."""
+    from typing import Optional
+
+    stream = [
+        (1, 5, 0, 1),
+        (1, 3, 2, 1),
+        (1, None, 4, 1),  # None in v -> object column -> decolumnarize
+        (1, 2, 6, 1),
+    ]
+    sch = pw.schema_from_types(k=int, v=Optional[int])
+    t = pw.debug.table_from_rows(sch, stream, is_stream=True)
+    g = t.groupby(t.k).reduce(t.k, s=pw.reducers.sum(t.v), c=pw.reducers.count())
+    assert rows_of(g) == {(1, 10, 4): 1}
