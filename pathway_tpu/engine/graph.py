@@ -39,6 +39,23 @@ class Node:
 
     name: str = "node"
 
+    #: attribute names that constitute this node's operator state; empty =
+    #: stateless. The operator-persistence layer (``persistence/snapshots.py``,
+    #: reference ``src/persistence/operator_snapshot.rs:21-342``) pickles these
+    #: at snapshot ticks and restores them on restart, making recovery
+    #: O(state) instead of O(history).
+    snapshot_attrs: tuple[str, ...] = ()
+
+    def snapshot_state(self) -> dict | None:
+        """Operator state for persistence, or None when stateless."""
+        if not self.snapshot_attrs:
+            return None
+        return {a: getattr(self, a) for a in self.snapshot_attrs}
+
+    def restore_state(self, state: dict) -> None:
+        for a, v in state.items():
+            setattr(self, a, v)
+
     def exchange_key(self, port: int):
         # stateful nodes keyed by row key need co-location by row key; stateless
         # subclasses override with None, specially-keyed ones with their key fn

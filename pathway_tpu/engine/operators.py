@@ -36,6 +36,8 @@ from pathway_tpu.internals.keys import combine_keys, row_keys, splitmix64
 class StaticInputNode(Node):
     name = "static_input"
 
+    snapshot_attrs = ("_emitted",)
+
     def exchange_key(self, port):
         return SOLO  # sources/sinks live on worker 0
 
@@ -63,6 +65,8 @@ class StreamInputNode(Node):
 
     name = "stream_input"
 
+    snapshot_attrs = ("_state",)
+
     def exchange_key(self, port):
         return SOLO  # sources/sinks live on worker 0
 
@@ -74,6 +78,9 @@ class StreamInputNode(Node):
         self._lock = threading.Lock()
         self._pending: list[tuple[int, tuple | None, int]] = []  # (key, values, diff)
         self._state: dict[int, tuple] = {}  # upsert sessions remember current row
+        # input events drained by poll() so far — the operator-snapshot offset:
+        # state at a snapshot reflects exactly this many log events
+        self.polled_total = 0
 
     # called from connector threads
     def push(self, key: int, values: tuple | None, diff: int = 1) -> None:
@@ -87,7 +94,10 @@ class StreamInputNode(Node):
     def poll(self, time: int) -> list[DeltaBatch]:
         with self._lock:
             pending, self._pending = self._pending, []
-        if not pending or time == END_OF_STREAM:
+        if time == END_OF_STREAM:
+            return []
+        self.polled_total += len(pending)
+        if not pending:
             return []
         keys: list[int] = []
         diffs: list[int] = []
@@ -305,6 +315,8 @@ class GroupByNode(Node):
     """
 
     name = "groupby"
+
+    snapshot_attrs = ("state", "cstate", "use_dict", "_seq", "_archived")
 
     def exchange_key(self, port):
         return self._gkeys  # co-locate rows of one group
@@ -762,6 +774,8 @@ class CombineNode(Node):
 
     name = "combine"
 
+    snapshot_attrs = ("side_state", "emitted")
+
     def __init__(
         self,
         sides: list[SideSpec],
@@ -848,6 +862,8 @@ class JoinNode(Node):
     """
 
     name = "join"
+
+    snapshot_attrs = ("store", "jk_counts")
 
     def exchange_key(self, port):
         col = self.left_on if port == 0 else self.right_on
@@ -1011,8 +1027,9 @@ class JoinNode(Node):
                         )
                     )
             # apply my delta to my state; 0<->+ transitions flip the other
-            # side's padded rows
-            if self.how == "inner":
+            # side's padded rows. My jk counts are only consulted when the
+            # OTHER side pads (== pad_other), so one-sided joins track one side.
+            if not pad_other:
                 if sign < 0:
                     self.store[side].delete(q_jk, q_rk)
                 else:
@@ -1027,7 +1044,7 @@ class JoinNode(Node):
                 self.store[side].insert(q_jk, q_rk, q_cols)
                 flipped = uniq[(prev <= 0) & (new > 0)]
                 flip_diff = -1  # other side gained a first match: padded rows retract
-            if pad_other and len(flipped):
+            if len(flipped):
                 f_q, f_rk, f_cols = other.match(flipped)
                 if len(f_q):
                     out.append(
@@ -1112,6 +1129,8 @@ class CaptureNode(Node):
     full stream of deltas (stream assertions)."""
 
     name = "capture"
+
+    snapshot_attrs = ("current", "deltas")
 
     def exchange_key(self, port):
         return SOLO  # sources/sinks live on worker 0

@@ -120,6 +120,8 @@ class IterateRunnerNode(Node):
 
     name = "iterate"
 
+    snapshot_attrs = ("input_state", "emitted")
+
     def exchange_key(self, port: int):
         return SOLO  # the fixed-point driver is a serial operator
 

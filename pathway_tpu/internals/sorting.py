@@ -29,6 +29,8 @@ from pathway_tpu.internals.logical import LogicalNode
 class SortNode(Node):
     name = "sort"
 
+    snapshot_attrs = ("_row_info", "_orders", "_emitted")
+
     def exchange_key(self, port):
         from pathway_tpu.engine.graph import SOLO
 

@@ -78,6 +78,7 @@ class _WatermarkNode(Node):
 
 class BufferNode(_WatermarkNode):
     name = "buffer"
+    snapshot_attrs = ("watermark", "_tick_max", "_held")
 
     def __init__(self, threshold_fn, current_time_fn):
         super().__init__(threshold_fn, current_time_fn)
@@ -149,6 +150,7 @@ class BufferNode(_WatermarkNode):
 
 class ForgetNode(_WatermarkNode):
     name = "forget"
+    snapshot_attrs = ("watermark", "_tick_max", "_live", "_columns")
 
     def __init__(self, threshold_fn, current_time_fn, mark_forgetting_records=False):
         super().__init__(threshold_fn, current_time_fn)
@@ -203,6 +205,7 @@ class ForgetNode(_WatermarkNode):
 
 class FreezeNode(_WatermarkNode):
     name = "freeze"
+    snapshot_attrs = ("watermark", "_tick_max", "_frozen", "_pending_freeze")
 
     def __init__(self, threshold_fn, current_time_fn):
         super().__init__(threshold_fn, current_time_fn)

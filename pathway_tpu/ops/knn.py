@@ -140,6 +140,22 @@ class BruteForceKnnIndex:
         self._pending_rows: list[np.ndarray] = []
         self._pending_invalidate: list[int] = []
 
+    def __getstate__(self):
+        """Snapshot form: device arrays DMA'd to host (operator persistence
+        writes this at snapshot ticks; reference ``operator_snapshot.rs``)."""
+        self._flush()
+        d = dict(self.__dict__)
+        d["_vectors"] = np.asarray(self._vectors)
+        d["_norms_sq"] = np.asarray(self._norms_sq)
+        d["_valid"] = np.asarray(self._valid)
+        return d
+
+    def __setstate__(self, d):
+        self.__dict__.update(d)
+        self._vectors = jnp.asarray(d["_vectors"])
+        self._norms_sq = jnp.asarray(d["_norms_sq"])
+        self._valid = jnp.asarray(d["_valid"])
+
     # -- capacity ------------------------------------------------------------
     @property
     def capacity(self) -> int:

@@ -37,6 +37,8 @@ class Backend:
 @dataclass
 class Config:
     backend: Backend
+    #: operator-snapshot cadence; <=0 = snapshot only at shutdown (default),
+    #: so steady-state ticks never pay O(state) serialization
     snapshot_interval_ms: int = 0
     persistence_mode: str = "persisting"
     snapshot_access: str = "full"

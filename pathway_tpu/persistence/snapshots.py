@@ -12,9 +12,18 @@ Block-engine counterpart of the reference's persistence core (``src/persistence/
 - **Metadata** (``state.rs:17,35``): per-source committed offset + last logical
   time, written on every flush; the restart point is what all sources have
   committed (single-process: the minimum is trivial).
-Operator snapshots (``operator_snapshot.rs``) are not implemented yet — a partial
-restore of stateful nodes would be silently wrong, so ``operator_persisting``
-raises until every stateful node implements an explicit save/restore contract.
+- **Operator snapshots** (``operator_snapshot.rs:21,26,342``): in
+  ``persistence_mode="operator_persisting"``, every stateful engine node
+  (declared via ``Node.snapshot_attrs``) is pickled at snapshot ticks together
+  with a manifest recording, per source, how many log events that state
+  reflects (``StreamInputNode.polled_total`` — a quiesced engine has applied
+  exactly the polled prefix). Restart restores node state, replays only the
+  log suffix past the manifest offset, and seeks live sources — recovery is
+  O(state + suffix), not O(history). Event-log chunks fully covered by the
+  manifest offset are deleted (compaction), and a snapshot generation is only
+  referenced by the manifest after all its node states are durable, so a crash
+  mid-save falls back to the previous generation.
+
 Consistency level matches the reference's OSS tier: at-least-once on restart
 (SURVEY §5.3; exactly-once output dedup is enterprise there, future work here).
 """
@@ -22,6 +31,7 @@ Consistency level matches the reference's OSS tier: at-least-once on restart
 from __future__ import annotations
 
 import pickle
+import time as _time
 from typing import Any
 
 from pathway_tpu.engine import operators as ops
@@ -29,6 +39,7 @@ from pathway_tpu.persistence.backends import KVBackend, backend_from_config
 
 _CHUNK = "chunk"
 _META = "metadata"
+_MANIFEST = "operators/manifest"
 
 
 class _PersistedInput:
@@ -41,6 +52,7 @@ class _PersistedInput:
         backend: KVBackend,
         live_after_replay: bool = True,
         subject: Any = None,
+        replay_skip: int = 0,
     ):
         self.pid = pid
         self.node = node
@@ -59,8 +71,13 @@ class _PersistedInput:
         self.stored_offset = 0  # events already persisted (skip this many live)
         self.seen_live = 0
         self.n_chunks = 0
+        self.first_chunk = 0  # chunks below this were compacted away
+        self.trimmed_events = 0  # events contained in compacted chunks
+        self.chunk_sizes: list[int] = []  # sizes of chunks [first_chunk, n_chunks)
         self._load_metadata()
         self.persisted = self.stored_offset
+        # operator snapshots: state already covers this absolute log prefix
+        self.replay_skip = min(replay_skip, self.persisted)
         if self.seekable:
             if self.reader_state is not None:
                 subject.seek(self.reader_state)
@@ -78,6 +95,16 @@ class _PersistedInput:
             self.stored_offset = meta["offset"]
             self.n_chunks = meta["chunks"]
             self.reader_state = meta.get("reader")
+            self.first_chunk = meta.get("first_chunk", 0)
+            self.trimmed_events = meta.get("trimmed_events", 0)
+            self.chunk_sizes = meta.get("chunk_sizes", [])
+            if len(self.chunk_sizes) != self.n_chunks - self.first_chunk:
+                # metadata predates size tracking: reconstruct from the chunks
+                # themselves so trim() never mis-accounts legacy storage
+                self.chunk_sizes = []
+                for i in range(self.first_chunk, self.n_chunks):
+                    c = self.backend.get(self._key(f"{_CHUNK}_{i:08d}"))
+                    self.chunk_sizes.append(len(pickle.loads(c)) if c is not None else 0)
 
     def _flush_metadata(self) -> None:
         self.backend.put(
@@ -87,19 +114,34 @@ class _PersistedInput:
                     "offset": self.persisted,
                     "chunks": self.n_chunks,
                     "reader": self.reader_state,
+                    "first_chunk": self.first_chunk,
+                    "trimmed_events": self.trimmed_events,
+                    "chunk_sizes": self.chunk_sizes,
                 }
             ),
         )
 
     def replay(self) -> None:
         """Push the stored event log into the node (before live reads start) —
-        through the ORIGINAL push so replay isn't counted as live traffic."""
-        for i in range(self.n_chunks):
+        through the ORIGINAL push so replay isn't counted as live traffic.
+        With an operator snapshot, only the suffix past ``replay_skip`` runs."""
+        to_skip = self.replay_skip - self.trimmed_events
+        for i in range(self.first_chunk, self.n_chunks):
             raw = self.backend.get(self._key(f"{_CHUNK}_{i:08d}"))
             if raw is None:
+                # chunk deleted by trim() but the crash hit before its metadata
+                # flush: consume its skip credit so later chunks stay aligned
+                # (trim only ever deletes fully-consumed chunks)
+                size = self.chunk_sizes[i - self.first_chunk]
+                to_skip = max(0, to_skip - size)
                 continue
-            for key, values, diff in pickle.loads(raw):
+            events = pickle.loads(raw)
+            if to_skip >= len(events):
+                to_skip -= len(events)
+                continue
+            for key, values, diff in events[to_skip:]:
                 self._original_push(key, values, diff)
+            to_skip = 0
 
     def flush(self) -> None:
         # for seekable sources, buffer capture + reader-state read happen under
@@ -119,9 +161,31 @@ class _PersistedInput:
         self.backend.put(
             self._key(f"{_CHUNK}_{self.n_chunks:08d}"), pickle.dumps(chunk)
         )
+        self.chunk_sizes.append(len(chunk))
         self.n_chunks += 1
         self.persisted += len(chunk)
         self._flush_metadata()
+
+    def consumed(self) -> int:
+        """Absolute log-event count the engine has applied (valid when the
+        engine is quiesced, i.e. at tick boundaries)."""
+        return self.replay_skip + self.node.polled_total
+
+    def trim(self, consumed: int) -> None:
+        """Delete log chunks fully covered by an operator snapshot at
+        ``consumed`` (compaction; ``operator_snapshot.rs:342`` semantics)."""
+        changed = False
+        while self.first_chunk < self.n_chunks and self.chunk_sizes:
+            size = self.chunk_sizes[0]
+            if self.trimmed_events + size > consumed:
+                break
+            self.backend.delete(self._key(f"{_CHUNK}_{self.first_chunk:08d}"))
+            self.trimmed_events += size
+            self.first_chunk += 1
+            self.chunk_sizes.pop(0)
+            changed = True
+        if changed:
+            self._flush_metadata()
 
     # -- node wrapping ------------------------------------------------------
     def _install(self) -> None:
@@ -148,20 +212,119 @@ class _PersistedInput:
         self.node.push_many = push_many  # type: ignore[method-assign]
 
 
+class _OperatorSnapshots:
+    """Generation-addressed node-state store + manifest."""
+
+    def __init__(self, backend: KVBackend, interval_s: float):
+        self.backend = backend
+        self.interval_s = interval_s
+        self.manifest = self._load_manifest()
+        self.gen = (self.manifest["gen"] + 1) if self.manifest else 0
+        self._last_save = _time.monotonic()
+
+    def _load_manifest(self) -> dict | None:
+        raw = self.backend.get(_MANIFEST)
+        return pickle.loads(raw) if raw is not None else None
+
+    def due(self) -> bool:
+        # interval<=0: snapshot only at close (pickling whole join/groupby
+        # state every tick would put O(state) on the hot path)
+        if self.interval_s <= 0:
+            return False
+        return _time.monotonic() - self._last_save >= self.interval_s
+
+    def validate(self, signature: list) -> bool:
+        """A changed graph shape invalidates operator snapshots (node identity
+        is positional). The signature covers node names, arities, output
+        columns and wiring — NOT operator parameters (a changed filter
+        constant or reducer expression with identical shape is the user's
+        responsibility, as in the reference's persistent-id contract)."""
+        return self.manifest is not None and self.manifest.get("node_names") == signature
+
+    def restore(self, nodes: list) -> None:
+        g = self.manifest["gen"]
+        for node in nodes:
+            raw = self.backend.get(f"operators/gen_{g:08d}/node_{node.node_index:05d}")
+            if raw is not None:
+                node.restore_state(pickle.loads(raw))
+
+    def save(
+        self,
+        nodes: list,
+        node_names: list[str],
+        input_offsets: dict[str, int],
+        tick: int,
+    ) -> None:
+        g = self.gen
+        for node in nodes:
+            state = node.snapshot_state()
+            if state is None:
+                continue
+            self.backend.put(
+                f"operators/gen_{g:08d}/node_{node.node_index:05d}", pickle.dumps(state)
+            )
+        # the manifest is the commit point: readers only ever follow it
+        self.backend.put(
+            _MANIFEST,
+            pickle.dumps(
+                {
+                    "gen": g,
+                    "tick": tick,
+                    "input_offsets": input_offsets,
+                    "node_names": node_names,
+                }
+            ),
+        )
+        if g > 0:
+            for k in self.backend.list_keys(f"operators/gen_{g - 1:08d}/"):
+                self.backend.delete(k)
+        self.gen += 1
+        self._last_save = _time.monotonic()
+
+
 class Persistence:
     def __init__(self, config, runtime=None):
         self.config = config
         self.runtime = runtime
         self.backend = backend_from_config(config.backend)
-        if config.persistence_mode == "operator_persisting":
-            raise NotImplementedError(
-                "operator_persisting is not implemented yet; use the default "
-                "input-snapshot mode (persistence_mode='persisting')"
-            )
+        self.operator_mode = config.persistence_mode == "operator_persisting"
         self.inputs: list[_PersistedInput] = []
+        self.opsnap: _OperatorSnapshots | None = None
+        self._nodes: list = []
+        self._node_names: list = []
 
     # called by Runtime once the engine graph is built, before drivers start
     def on_graph_built(self, ctx) -> None:
+        offsets: dict[str, int] = {}
+        if self.operator_mode:
+            self._nodes = list(ctx.graph.nodes)
+            self._node_names = [
+                (
+                    n.name,
+                    n.n_inputs,
+                    tuple(getattr(n, "columns", None) or getattr(n, "out_columns", []) or []),
+                    tuple(ctx.graph.edges.get(n.node_index, [])),
+                )
+                for n in self._nodes
+            ]
+            self.opsnap = _OperatorSnapshots(
+                self.backend, self.config.snapshot_interval_ms / 1000.0
+            )
+            if self.opsnap.manifest is not None:
+                if not self.opsnap.validate(self._node_names):
+                    # operator snapshots are positional AND compaction already
+                    # dropped the consumed log prefix — a different graph can
+                    # neither restore nor recompute; refuse loudly instead of
+                    # silently losing the compacted history
+                    raise RuntimeError(
+                        "operator_persisting: persisted snapshots were taken "
+                        "for a different pipeline graph "
+                        f"(stored {self.opsnap.manifest.get('node_names')}, "
+                        f"current {self._node_names}); clear the persistence "
+                        "storage or revert the pipeline change"
+                    )
+                offsets = dict(self.opsnap.manifest["input_offsets"])
+                self.opsnap.restore(self._nodes)
         # pid stability: a source keeps its snapshots across unrelated pipeline
         # edits — use the connector's name alone when unique among sources, and
         # only disambiguate same-named sources by their order among sources
@@ -188,6 +351,7 @@ class Persistence:
                     self.backend,
                     live_after_replay=getattr(self.config, "continue_after_replay", True),
                     subject=self._subject_of(node),
+                    replay_skip=offsets.get(pid, 0),
                 )
             )
         for p in self.inputs:
@@ -201,12 +365,24 @@ class Persistence:
                 return subject
         return None
 
+    def _save_operators(self, time: int) -> None:
+        assert self.opsnap is not None
+        offsets = {p.pid: p.consumed() for p in self.inputs}
+        self.opsnap.save(self._nodes, self._node_names, offsets, time)
+        for p in self.inputs:
+            p.trim(offsets[p.pid])
+
     def on_tick_done(self, time: int) -> None:
         for p in self.inputs:
             p.flush()
+        if self.operator_mode and self.opsnap is not None and self.opsnap.due():
+            self._save_operators(time)
 
     def on_close(self) -> None:
-        self.on_tick_done(-1)
+        for p in self.inputs:
+            p.flush()
+        if self.operator_mode and self.opsnap is not None:
+            self._save_operators(-1)
 
 
 def attach(runtime, config) -> None:

@@ -168,6 +168,9 @@ class ExternalIndexNode(Node):
 
     name = "external_index"
 
+    # _filter_cache (compiled callables) is rebuilt lazily, not persisted
+    snapshot_attrs = ("backend", "_live_queries", "_emitted")
+
     def exchange_key(self, port):
         from pathway_tpu.engine.graph import SOLO
 

@@ -60,6 +60,11 @@ class TemporalJoinNode(Node):
 
     name = "temporal_join"
 
+    snapshot_attrs = (
+        "left", "right", "_groups", "_group_pairs", "_pair_rows",
+        "_match_count_l", "_match_count_r", "_pads_l", "_pads_r",
+    )
+
     def exchange_key(self, port):
         return lambda batch: batch.data["__jk__"].astype(np.uint64)
 
@@ -276,6 +281,8 @@ class AsofNowJoinNode(Node):
     """Append-only left (queries) joined against right state as of arrival."""
 
     name = "asof_now_join"
+
+    snapshot_attrs = ("right", "_right_by_jk", "_answered")
 
     def exchange_key(self, port):
         from pathway_tpu.engine.graph import SOLO

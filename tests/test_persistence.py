@@ -144,13 +144,165 @@ def test_mock_backend_records_operations():
     assert ("put", "k") in b.operations and ("get", "k") in b.operations
 
 
-def test_operator_persisting_mode_rejected():
-    with pytest.raises(NotImplementedError):
-        from pathway_tpu.persistence.snapshots import Persistence
+def test_operator_persisting_mode_accepted():
+    from pathway_tpu.persistence.snapshots import Persistence
 
-        Persistence(
-            pw.persistence.Config(
-                backend=pw.persistence.Backend.memory(),
-                persistence_mode="operator_persisting",
+    p = Persistence(
+        pw.persistence.Config(
+            backend=pw.persistence.Backend.memory(),
+            persistence_mode="operator_persisting",
+        )
+    )
+    assert p.operator_mode
+
+
+# ---------------------------------------------------------------- operator mode
+
+
+def run_operator_session(rows, backend, collect, mode="operator_persisting"):
+    G.clear()
+    subj = ListSubject(rows)
+    t = pw.io.python.read(subj, schema=S, name="wordsource")
+    agg = t.groupby(pw.this.word).reduce(
+        pw.this.word, total=pw.reducers.sum(pw.this.count)
+    )
+    results = {}
+    pw.io.subscribe(
+        agg,
+        on_change=lambda key, row, time, is_addition: results.__setitem__(
+            row["word"], row["total"]
+        )
+        if is_addition
+        else None,
+    )
+    pw.run(
+        persistence_config=pw.persistence.Config(
+            backend=backend, persistence_mode=mode
+        )
+    )
+    collect.update(results)
+    return subj
+
+
+def test_operator_snapshot_restart_is_o_state(tmp_path):
+    """Restart with operator snapshots must restore node state and replay only
+    the log suffix — not the whole history."""
+    backend = pw.persistence.Backend.filesystem(str(tmp_path / "pstate"))
+
+    out1: dict = {}
+    run_operator_session([("a", 1), ("b", 2), ("a", 3)], backend, out1)
+    assert out1 == {"a": 4, "b": 2}
+
+    # second run: longer deterministic source; replay must be suffix-only
+    import pathway_tpu.persistence.snapshots as snapmod
+
+    pushed_on_replay: list = []
+    orig_replay = snapmod._PersistedInput.replay
+
+    def counting_replay(self):
+        before = self.node.__dict__.get("_replayed_probe", 0)
+        orig_push = self._original_push
+
+        def probe(key, values, diff):
+            pushed_on_replay.append((key, values, diff))
+            orig_push(key, values, diff)
+
+        self._original_push = probe
+        try:
+            orig_replay(self)
+        finally:
+            self._original_push = orig_push
+
+    snapmod._PersistedInput.replay = counting_replay
+    try:
+        out2: dict = {}
+        run_operator_session(
+            [("a", 1), ("b", 2), ("a", 3), ("b", 10), ("c", 5)], backend, out2
+        )
+    finally:
+        snapmod._PersistedInput.replay = orig_replay
+    # state was snapshotted past all 3 events of run 1 -> zero events replayed
+    assert pushed_on_replay == [], pushed_on_replay
+    # resumed run emits only NEW deltas ("a" was delivered in run 1 and its
+    # aggregate didn't change -- no re-emission, that's the O(state) contract)
+    assert out2 == {"b": 12, "c": 5}
+
+
+def test_operator_snapshot_compacts_log(tmp_path):
+    backend = pw.persistence.Backend.filesystem(str(tmp_path / "pstate"))
+    out1: dict = {}
+    run_operator_session([("a", 1), ("b", 2)], backend, out1)
+    out2: dict = {}
+    run_operator_session([("a", 1), ("b", 2), ("c", 3)], backend, out2)
+    assert out2 == {"c": 3}  # only the new word produces a delta
+    # all consumed chunks were deleted by compaction
+    fb = FileBackend(str(tmp_path / "pstate"))
+    chunk_keys = [k for k in fb.list_keys("inputs/") if "chunk" in k]
+    assert chunk_keys == [], chunk_keys
+
+
+def test_operator_snapshot_graph_change_is_refused(tmp_path):
+    backend = pw.persistence.Backend.filesystem(str(tmp_path / "pstate"))
+    out1: dict = {}
+    run_operator_session([("a", 1), ("b", 2)], backend, out1)
+
+    # a different pipeline shape over the same storage: operator snapshots are
+    # positional, so they must be invalidated and the log replayed in full
+    G.clear()
+    subj = ListSubject([("a", 1), ("b", 2), ("c", 9)])
+    t = pw.io.python.read(subj, schema=S, name="wordsource")
+    filtered = t.filter(pw.this.count > 0)
+    agg = filtered.groupby(pw.this.word).reduce(
+        pw.this.word, total=pw.reducers.sum(pw.this.count)
+    )
+    results = {}
+    pw.io.subscribe(
+        agg,
+        on_change=lambda key, row, time, is_addition: results.__setitem__(
+            row["word"], row["total"]
+        )
+        if is_addition
+        else None,
+    )
+    # compaction already dropped the consumed log prefix, so a different
+    # graph can neither restore the positional snapshots nor recompute them:
+    # the runtime must refuse instead of silently losing history
+    with pytest.raises(RuntimeError, match="different pipeline graph"):
+        pw.run(
+            persistence_config=pw.persistence.Config(
+                backend=backend, persistence_mode="operator_persisting"
             )
         )
+
+
+def test_operator_snapshot_join_state(tmp_path):
+    """Join state (columnar multimap) must survive a restart."""
+    backend = pw.persistence.Backend.filesystem(str(tmp_path / "pstate"))
+
+    def session(rows, expect):
+        G.clear()
+        subj = ListSubject(rows)
+        left = pw.io.python.read(subj, schema=S, name="left")
+        right = pw.debug.table_from_rows(
+            pw.schema_from_types(word=str, factor=int), [("a", 10), ("b", 100)]
+        )
+        j = left.join(right, left.word == right.word).select(
+            word=left.word, scaled=left.count * right.factor
+        )
+        got = {}
+        pw.io.subscribe(
+            j,
+            on_change=lambda key, row, time, is_addition: got.__setitem__(
+                (row["word"], row["scaled"]), is_addition
+            ),
+        )
+        pw.run(
+            persistence_config=pw.persistence.Config(
+                backend=backend, persistence_mode="operator_persisting"
+            )
+        )
+        live = {k for k, add in got.items() if add}
+        assert expect.issubset(live), (expect, live)
+
+    session([("a", 1)], {("a", 10)})
+    session([("a", 1), ("b", 3)], {("b", 300)})
