@@ -17,7 +17,7 @@ import numpy as np
 
 from pathway_tpu.internals import dtype as dt
 from pathway_tpu.internals import expression as expr_mod
-from pathway_tpu.internals.errors import ERROR
+from pathway_tpu.internals.errors import ERROR, report_error
 from pathway_tpu.internals.expression import (
     ApplyExpression,
     AsyncApplyExpression,
@@ -116,8 +116,8 @@ def _obj_binop(op: str, a: np.ndarray, b: np.ndarray) -> np.ndarray:
         else:
             try:
                 out[i] = fn(x, y)
-            except Exception:
-                out[i] = ERROR
+            except Exception as e:
+                out[i] = report_error(f"{op}: {e!r}")
     return out
 
 
@@ -322,8 +322,8 @@ def _eval_apply(expr: ApplyExpression, ctx: EvalContext) -> np.ndarray:
             continue
         try:
             out[i] = fn(*args, **kwargs)
-        except Exception:
-            out[i] = ERROR
+        except Exception as e:
+            out[i] = report_error(f"apply {getattr(fn, '__name__', fn)!s}: {e!r}")
     return _tighten(out, expr.return_type)
 
 
@@ -365,8 +365,10 @@ def _eval_batch_apply(expr: "BatchApplyExpression", ctx: EvalContext) -> np.ndar
                         **{k: [arr[i]] for k, arr in zip(kw_names, kw_arrays)},
                     )
                     out[i] = r[0]
-                except Exception:
-                    out[i] = ERROR
+                except Exception as e:
+                    out[i] = report_error(
+                        f"apply {getattr(expr.fn, '__name__', expr.fn)!s}: {e!r}"
+                    )
     return _tighten(out, expr.return_type)
 
 
@@ -386,8 +388,10 @@ def _eval_async_apply(expr: AsyncApplyExpression, ctx: EvalContext) -> np.ndarra
                     *[arr[i] for arr in arrays],
                     **{k: arr[i] for k, arr in zip(kw_names, kw_arrays)},
                 )
-            except Exception:
-                return ERROR
+            except Exception as e:
+                return report_error(
+                    f"async apply {getattr(fn, '__name__', fn)!s}: {e!r}"
+                )
 
         return await asyncio.gather(*[one(i) for i in range(ctx.n)])
 
@@ -437,8 +441,8 @@ def _cast_array(a: np.ndarray, target: dt.DType) -> np.ndarray:
             else:
                 try:
                     out[i] = conv(v)
-                except (ValueError, TypeError):
-                    out[i] = ERROR
+                except (ValueError, TypeError) as e:
+                    out[i] = report_error(f"cast to {target}: {e!r}")
         return _tighten(out, target)
     if npd == np.dtype(object):
         if dt.unoptionalize(target) == dt.STR:

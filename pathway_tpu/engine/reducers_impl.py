@@ -222,7 +222,11 @@ class ArgMaxReducer(MultisetReducer):
 class UniqueReducer(MultisetReducer):
     def extract(self, state):
         if len(state.items) != 1:
-            return ERROR
+            from pathway_tpu.internals.errors import report_error
+
+            return report_error(
+                "unique reducer: group holds more than one distinct value"
+            )
         return next(iter(state.items.values()))[0][0]
 
 

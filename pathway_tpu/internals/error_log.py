@@ -17,6 +17,11 @@ def log_error(operator_id: int, message: str, trace: str = "") -> None:
         _entries.append((operator_id, message, trace))
 
 
+def clear() -> None:
+    with _lock:
+        _entries.clear()
+
+
 ERROR_LOG_SCHEMA = schema_mod.schema_from_types(
     operator_id=int, message=str, trace=str
 )

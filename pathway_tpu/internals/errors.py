@@ -50,3 +50,35 @@ class EngineError(Exception):
 
 class EngineErrorWithTrace(EngineError):
     pass
+
+
+# -- error policy (reference: terminate_on_error flag threaded into the engine,
+# ``src/engine/error.rs`` + ``internals/run.py``) ------------------------------
+
+# module default is poison-mode (debug/compute tooling inspects ERROR values);
+# ``pw.run`` sets the policy from its ``terminate_on_error`` kwarg for the run
+_policy = {"terminate": False}
+
+
+def set_error_policy(terminate: bool) -> None:
+    _policy["terminate"] = terminate
+
+
+def get_error_policy() -> bool:
+    return _policy["terminate"]
+
+
+def report_error(message: str, trace: str = "", operator_id: int = -1):
+    """Row-level failure. ``terminate_on_error=True`` (the default) aborts the
+    run with the original failure; ``False`` logs to ``pw.global_error_log()``
+    and returns ERROR, which poisons downstream expressions instead
+    (``Value::Error`` semantics, ``src/engine/value.rs:207-229``)."""
+    if _policy["terminate"]:
+        raise EngineErrorWithTrace(
+            f"{message}\n(set terminate_on_error=False to route row-level "
+            "failures to pw.global_error_log() instead)"
+        )
+    from pathway_tpu.internals.error_log import log_error
+
+    log_error(operator_id, message, trace)
+    return ERROR

@@ -1,0 +1,150 @@
+"""Monitoring: per-operator stats, console dashboard, HTTP/Prometheus endpoint.
+
+Role of the reference's monitoring stack (``internals/monitoring.py:22-271``
+dashboard + ``src/engine/http_server.rs:25-77`` metrics server): engine nodes
+already count rows in/out and processing time; this module aggregates them into
+
+- a console summary (``monitoring_level`` AUTO/IN_OUT/ALL — AUTO prints only on
+  a TTY, NONE is silent),
+- ``/status`` (JSON) and ``/metrics`` (Prometheus text exposition) served by a
+  daemon-thread HTTP server while the run is live (``with_http_server=True``;
+  port from ``PATHWAY_MONITORING_HTTP_PORT``, default 20000).
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any
+
+
+def scheduler_stats(scheduler) -> list[dict[str, Any]]:
+    """Per-operator counters from a live or finished scheduler."""
+    if scheduler is None:
+        return []
+    out = []
+    for node in scheduler.graph.nodes:
+        out.append(
+            {
+                "id": node.node_index,
+                "operator": node.name,
+                "rows_in": node.stats_rows_in,
+                "rows_out": node.stats_rows_out,
+                "time_ms": round(node.stats_time_ns / 1e6, 3),
+            }
+        )
+    return out
+
+
+def run_stats(runtime) -> dict[str, Any]:
+    scheduler = getattr(runtime, "scheduler", None)
+    ops = scheduler_stats(scheduler)
+    return {
+        "alive": True,
+        "current_time": getattr(scheduler, "current_time", None),
+        "operators": ops,
+        "rows_in_total": sum(o["rows_in"] for o in ops),
+        "rows_out_total": sum(o["rows_out"] for o in ops),
+    }
+
+
+def prometheus_text(runtime) -> str:
+    """Prometheus exposition format (``http_server.rs`` metric names adapted)."""
+    stats = run_stats(runtime)
+    lines = [
+        "# HELP pathway_operator_rows_in_total Rows consumed by an operator",
+        "# TYPE pathway_operator_rows_in_total counter",
+    ]
+    for o in stats["operators"]:
+        label = f'operator="{o["operator"]}",id="{o["id"]}"'
+        lines.append(f'pathway_operator_rows_in_total{{{label}}} {o["rows_in"]}')
+    lines += [
+        "# HELP pathway_operator_rows_out_total Rows emitted by an operator",
+        "# TYPE pathway_operator_rows_out_total counter",
+    ]
+    for o in stats["operators"]:
+        label = f'operator="{o["operator"]}",id="{o["id"]}"'
+        lines.append(f'pathway_operator_rows_out_total{{{label}}} {o["rows_out"]}')
+    lines += [
+        "# HELP pathway_operator_time_ms Time spent inside an operator",
+        "# TYPE pathway_operator_time_ms counter",
+    ]
+    for o in stats["operators"]:
+        label = f'operator="{o["operator"]}",id="{o["id"]}"'
+        lines.append(f'pathway_operator_time_ms{{{label}}} {o["time_ms"]}')
+    return "\n".join(lines) + "\n"
+
+
+class MonitoringHttpServer:
+    """``/status`` + ``/metrics`` over a daemon thread for the run's lifetime."""
+
+    def __init__(self, runtime, port: int | None = None):
+        import os
+
+        self.runtime = runtime
+        self.port = port if port is not None else int(
+            os.environ.get("PATHWAY_MONITORING_HTTP_PORT", "20000")
+        )
+        rt = runtime
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *args):  # quiet
+                pass
+
+            def do_GET(self):
+                if self.path.startswith("/metrics"):
+                    body = prometheus_text(rt).encode()
+                    ctype = "text/plain; version=0.0.4"
+                elif self.path.startswith("/status"):
+                    body = json.dumps(run_stats(rt)).encode()
+                    ctype = "application/json"
+                else:
+                    self.send_response(404)
+                    self.end_headers()
+                    return
+                self.send_response(200)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        self.server = ThreadingHTTPServer(("127.0.0.1", self.port), Handler)
+        self.port = self.server.server_address[1]
+        self.thread = threading.Thread(target=self.server.serve_forever, daemon=True)
+
+    def start(self) -> "MonitoringHttpServer":
+        self.thread.start()
+        return self
+
+    def stop(self) -> None:
+        self.server.shutdown()
+        self.server.server_close()
+
+
+def print_summary(runtime, level: str, file=None) -> str | None:
+    """Console dashboard at run end (reference's monitoring table, condensed).
+
+    AUTO prints only when attached to a TTY; IN_OUT shows connector/sink rows;
+    ALL shows every operator.
+    """
+    file = file or sys.stderr
+    if level in (None, "none"):
+        return None
+    if level == "auto" and not getattr(file, "isatty", lambda: False)():
+        return None
+    stats = run_stats(runtime)
+    ops = stats["operators"]
+    if level == "in_out":
+        edge = {"stream_input", "static_input", "subscribe", "capture", "output"}
+        ops = [o for o in ops if o["operator"] in edge]
+    width = max([len(o["operator"]) for o in ops] + [8])
+    lines = [f"{'operator':<{width}}  {'rows_in':>10}  {'rows_out':>10}  {'time_ms':>10}"]
+    for o in ops:
+        lines.append(
+            f"{o['operator']:<{width}}  {o['rows_in']:>10}  {o['rows_out']:>10}  {o['time_ms']:>10.1f}"
+        )
+    text = "\n".join(lines)
+    print(text, file=file)
+    return text

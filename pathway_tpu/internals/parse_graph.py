@@ -36,6 +36,11 @@ class ParseGraph:
 
     def clear(self) -> None:
         self.__init__()
+        # the error log is scoped to the graph (reference: per-graph log
+        # streams, parse_graph.py:183-238)
+        from pathway_tpu.internals import error_log
+
+        error_log.clear()
 
     def statistics(self) -> dict[str, int]:
         return dict(Counter(type(n).__name__ for n in self.nodes))

@@ -70,7 +70,7 @@ def run(
     autocommit_duration_ms: int | None = 20,
     persistence_config: Any = None,
     runtime_typechecking: bool | None = None,
-    terminate_on_error: bool = True,
+    terminate_on_error: bool | None = None,
     n_workers: int | None = None,
     **kwargs: Any,
 ) -> None:
@@ -107,9 +107,28 @@ def run(
 
         attach_persistence(runtime, persistence_config)
     _last_runtime = runtime
-    scheduler = runtime.run(list(G.outputs))
+    from pathway_tpu.internals import errors as _errors
+
+    http_server = None
     if with_http_server:
-        pass  # metrics server lifecycle is bound to the run; see monitoring module
+        from pathway_tpu.internals.monitoring import MonitoringHttpServer
+
+        http_server = MonitoringHttpServer(runtime).start()
+    if terminate_on_error is None:
+        # kwarg beats PATHWAY_TERMINATE_ON_ERROR beats True
+        terminate_on_error = get_pathway_config().terminate_on_error
+    prev_policy = _errors.get_error_policy()
+    _errors.set_error_policy(terminate_on_error)
+    try:
+        runtime.run(list(G.outputs))
+    finally:
+        _errors.set_error_policy(prev_policy)
+        if http_server is not None:
+            http_server.stop()
+        from pathway_tpu.internals.monitoring import print_summary
+
+        level = monitoring_level if isinstance(monitoring_level, str) else "auto"
+        print_summary(runtime, level)
     return None
 
 

@@ -144,21 +144,31 @@ class ShardedRuntime:
         return any_work
 
     def _parallel(self, fn) -> list:
-        """Run fn(worker) on every worker concurrently; collect results."""
+        """Run fn(worker) on every worker concurrently; collect results.
+        A worker exception (e.g. terminate_on_error aborting a batch) is
+        re-raised here so the run fails loudly instead of silently dropping
+        that worker's batch."""
         results = [None] * self.n_workers
         if self.n_workers == 1:
             results[0] = fn(self.workers[0])
             return results
+        errors: list[BaseException | None] = [None] * self.n_workers
         threads = []
         for i, w in enumerate(self.workers):
             def target(i=i, w=w):
-                results[i] = fn(w)
+                try:
+                    results[i] = fn(w)
+                except BaseException as e:  # noqa: BLE001 — transported to caller
+                    errors[i] = e
 
             t = threading.Thread(target=target)
             t.start()
             threads.append(t)
         for t in threads:
             t.join()
+        for e in errors:
+            if e is not None:
+                raise e
         return results
 
     def run_tick(self, time: int) -> None:

@@ -386,6 +386,15 @@ class Persistence:
 
 
 def attach(runtime, config) -> None:
+    if config.persistence_mode == "operator_persisting" and type(runtime).__name__ != "Runtime":
+        # sharded/cluster runtimes hold per-worker node shards; snapshotting
+        # only worker 0 while compacting the full log would silently lose the
+        # other workers' state — refuse until per-worker snapshots land
+        raise NotImplementedError(
+            "operator_persisting currently requires a single-worker runtime "
+            "(PATHWAY_THREADS=1, PATHWAY_PROCESSES=1); use the default "
+            "input-snapshot mode for multi-worker runs"
+        )
     runtime.persistence = Persistence(config, runtime)
     if config.backend.kind == "filesystem" and config.backend.path:
         # colocate UDF DiskCache with the persistent storage (reference:

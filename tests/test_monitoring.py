@@ -1,0 +1,122 @@
+"""Monitoring: /status + /metrics HTTP endpoints, console summary, stats.
+
+Reference: ``internals/monitoring.py:22-271`` (dashboard) +
+``src/engine/http_server.rs:25-77`` (metrics server).
+"""
+
+import io
+import json
+import threading
+import time
+import urllib.request
+
+import pathway_tpu as pw
+from pathway_tpu.internals.parse_graph import G
+
+
+class S(pw.Schema):
+    x: int
+
+
+def _pipeline():
+    G.clear()
+    t = pw.debug.table_from_rows(pw.schema_from_types(x=int), [(i,) for i in range(50)])
+    t = t.with_columns(m=t.x % 5)
+    g = t.groupby(t.m).reduce(s=pw.reducers.sum(t.x))
+    pw.io.subscribe(g, on_change=lambda **k: None)
+
+
+def test_http_server_status_and_metrics(free_tcp_port=20123):
+    import os
+
+    _pipeline()
+    os.environ["PATHWAY_MONITORING_HTTP_PORT"] = "0"  # ephemeral port
+
+    captured = {}
+    orig_run = pw.internals.run.Runtime.run
+
+    def slow_run(self, outputs):
+        captured["runtime"] = self
+        return orig_run(self, outputs)
+
+    # probe the endpoints mid-run by hooking the runtime loop via a thread
+    from pathway_tpu.internals.monitoring import MonitoringHttpServer
+
+    class RT:  # minimal runtime facade for the server
+        scheduler = None
+
+    rt = RT()
+    srv = MonitoringHttpServer(rt, port=0).start()
+    try:
+        status = json.loads(
+            urllib.request.urlopen(f"http://127.0.0.1:{srv.port}/status").read()
+        )
+        assert status["alive"] and status["operators"] == []
+        # now attach a real finished scheduler
+        pw.run(monitoring_level="none")
+        rt.scheduler = pw.internals.run.current_runtime().scheduler
+        status = json.loads(
+            urllib.request.urlopen(f"http://127.0.0.1:{srv.port}/status").read()
+        )
+        names = {o["operator"] for o in status["operators"]}
+        assert "groupby" in names and status["rows_in_total"] > 0
+        metrics = (
+            urllib.request.urlopen(f"http://127.0.0.1:{srv.port}/metrics")
+            .read()
+            .decode()
+        )
+        assert "pathway_operator_rows_in_total" in metrics
+        assert 'operator="groupby"' in metrics
+    finally:
+        srv.stop()
+
+
+def test_with_http_server_serves_during_run():
+    import os
+
+    os.environ["PATHWAY_MONITORING_HTTP_PORT"] = "20345"
+    G.clear()
+
+    class Slow(pw.io.python.ConnectorSubject):
+        def run(self):
+            for i in range(5):
+                self.next(x=i)
+                time.sleep(0.05)
+
+    t = pw.io.python.read(Slow(), schema=S)
+    pw.io.subscribe(t, on_change=lambda **k: None)
+
+    got = {}
+
+    def probe():
+        time.sleep(0.12)
+        try:
+            got["status"] = json.loads(
+                urllib.request.urlopen("http://127.0.0.1:20345/status", timeout=2).read()
+            )
+        except Exception as e:
+            got["error"] = repr(e)
+
+    th = threading.Thread(target=probe)
+    th.start()
+    pw.run(with_http_server=True, monitoring_level="none")
+    th.join()
+    assert "status" in got, got
+    assert got["status"]["alive"]
+
+
+def test_console_summary_levels():
+    from pathway_tpu.internals.monitoring import print_summary
+
+    _pipeline()
+    pw.run(monitoring_level="none")
+    rt = pw.internals.run.current_runtime()
+    buf = io.StringIO()
+    text = print_summary(rt, "all", file=buf)
+    assert text is not None and "groupby" in text
+    buf = io.StringIO()
+    text = print_summary(rt, "in_out", file=buf)
+    assert text is not None and "groupby" not in text and "static_input" in text
+    assert print_summary(rt, "none") is None
+    # auto on a non-tty stays silent
+    assert print_summary(rt, "auto", file=io.StringIO()) is None
