@@ -85,7 +85,9 @@ from pathway_tpu.internals.parse_graph import G
 
 # subpackages ----------------------------------------------------------------
 from pathway_tpu import debug, demo, io, persistence, stdlib, universes
-from pathway_tpu.stdlib import temporal, indexing, ml, graphs, statistical, utils as _stdlib_utils
+from pathway_tpu.stdlib import temporal, indexing, ml, graphs, statistical, stateful
+from pathway_tpu.stdlib import utils as utils
+from pathway_tpu.stdlib.utils.async_transformer import AsyncTransformer
 from pathway_tpu.internals.iterate import iterate, iterate_universe
 
 # commonly used temporal entry points at top level (parity with reference) ---
@@ -169,6 +171,9 @@ __all__ = [
     "io",
     "persistence",
     "stdlib",
+    "stateful",
+    "utils",
+    "AsyncTransformer",
     "temporal",
     "indexing",
     "universes",

@@ -21,21 +21,33 @@ from typing import Any
 
 
 def scheduler_stats(scheduler) -> list[dict[str, Any]]:
-    """Per-operator counters from a live or finished scheduler."""
+    """Per-operator counters from a live or finished scheduler. Sharded and
+    cluster runtimes expose per-worker graphs; their counters aggregate by
+    node position."""
     if scheduler is None:
         return []
-    out = []
-    for node in scheduler.graph.nodes:
-        out.append(
-            {
-                "id": node.node_index,
-                "operator": node.name,
-                "rows_in": node.stats_rows_in,
-                "rows_out": node.stats_rows_out,
-                "time_ms": round(node.stats_time_ns / 1e6, 3),
-            }
-        )
-    return out
+    graph = getattr(scheduler, "graph", None)
+    if graph is not None:
+        graphs = [graph]
+    else:
+        workers = getattr(scheduler, "workers", None) or []
+        graphs = [w.graph for w in workers if getattr(w, "graph", None) is not None]
+    agg: dict[int, dict[str, Any]] = {}
+    for g in graphs:
+        for node in g.nodes:
+            o = agg.get(node.node_index)
+            if o is None:
+                agg[node.node_index] = o = {
+                    "id": node.node_index,
+                    "operator": node.name,
+                    "rows_in": 0,
+                    "rows_out": 0,
+                    "time_ms": 0.0,
+                }
+            o["rows_in"] += node.stats_rows_in
+            o["rows_out"] += node.stats_rows_out
+            o["time_ms"] = round(o["time_ms"] + node.stats_time_ns / 1e6, 3)
+    return [agg[i] for i in sorted(agg)]
 
 
 def run_stats(runtime) -> dict[str, Any]:
@@ -53,27 +65,18 @@ def run_stats(runtime) -> dict[str, Any]:
 def prometheus_text(runtime) -> str:
     """Prometheus exposition format (``http_server.rs`` metric names adapted)."""
     stats = run_stats(runtime)
-    lines = [
-        "# HELP pathway_operator_rows_in_total Rows consumed by an operator",
-        "# TYPE pathway_operator_rows_in_total counter",
+    metrics = [
+        ("pathway_operator_rows_in_total", "Rows consumed by an operator", "rows_in"),
+        ("pathway_operator_rows_out_total", "Rows emitted by an operator", "rows_out"),
+        ("pathway_operator_time_ms", "Time spent inside an operator", "time_ms"),
     ]
-    for o in stats["operators"]:
-        label = f'operator="{o["operator"]}",id="{o["id"]}"'
-        lines.append(f'pathway_operator_rows_in_total{{{label}}} {o["rows_in"]}')
-    lines += [
-        "# HELP pathway_operator_rows_out_total Rows emitted by an operator",
-        "# TYPE pathway_operator_rows_out_total counter",
-    ]
-    for o in stats["operators"]:
-        label = f'operator="{o["operator"]}",id="{o["id"]}"'
-        lines.append(f'pathway_operator_rows_out_total{{{label}}} {o["rows_out"]}')
-    lines += [
-        "# HELP pathway_operator_time_ms Time spent inside an operator",
-        "# TYPE pathway_operator_time_ms counter",
-    ]
-    for o in stats["operators"]:
-        label = f'operator="{o["operator"]}",id="{o["id"]}"'
-        lines.append(f'pathway_operator_time_ms{{{label}}} {o["time_ms"]}')
+    labels = [f'operator="{o["operator"]}",id="{o["id"]}"' for o in stats["operators"]]
+    lines = []
+    for name, help_text, field in metrics:
+        lines.append(f"# HELP {name} {help_text}")
+        lines.append(f"# TYPE {name} counter")
+        for o, label in zip(stats["operators"], labels):
+            lines.append(f"{name}{{{label}}} {o[field]}")
     return "\n".join(lines) + "\n"
 
 
@@ -84,9 +87,12 @@ class MonitoringHttpServer:
         import os
 
         self.runtime = runtime
-        self.port = port if port is not None else int(
-            os.environ.get("PATHWAY_MONITORING_HTTP_PORT", "20000")
-        )
+        if port is None:
+            base = int(os.environ.get("PATHWAY_MONITORING_HTTP_PORT", "20000"))
+            # multi-process runs inherit one env: offset by process id so
+            # workers don't collide on the bind (reference http_server.rs)
+            port = 0 if base == 0 else base + int(os.environ.get("PATHWAY_PROCESS_ID", "0"))
+        self.port = port
         rt = runtime
 
         class Handler(BaseHTTPRequestHandler):

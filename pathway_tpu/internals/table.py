@@ -492,6 +492,20 @@ class Table:
 
         return sort_impl(self, self._bind(key), None if instance is None else self._bind(instance))
 
+    def interpolate(self, timestamp: Any, *values: Any, mode: Any = None) -> "Table":
+        from pathway_tpu.stdlib.statistical import InterpolateMode, interpolate
+
+        return interpolate(
+            self, timestamp, *values, mode=mode if mode is not None else InterpolateMode.LINEAR
+        )
+
+    def _gradual_broadcast(self, threshold_table, lower_column, value_column, upper_column) -> "Table":
+        from pathway_tpu.internals.gradual_broadcast import gradual_broadcast_impl
+
+        return gradual_broadcast_impl(
+            self, threshold_table, lower_column, value_column, upper_column
+        )
+
     def diff(self, timestamp: Any, *values: Any, instance: Any = None) -> "Table":
         from pathway_tpu.stdlib.ordered import diff_impl
 

@@ -386,7 +386,9 @@ class Persistence:
 
 
 def attach(runtime, config) -> None:
-    if config.persistence_mode == "operator_persisting" and type(runtime).__name__ != "Runtime":
+    from pathway_tpu.engine.runtime import Runtime as _SingleRuntime
+
+    if config.persistence_mode == "operator_persisting" and type(runtime) is not _SingleRuntime:
         # sharded/cluster runtimes hold per-worker node shards; snapshotting
         # only worker 0 while compacting the full log would silently lose the
         # other workers' state — refuse until per-worker snapshots land

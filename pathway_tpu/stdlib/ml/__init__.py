@@ -1,1 +1,7 @@
-"""Placeholder — implemented in a later milestone."""
+"""ML stdlib (reference: ``python/pathway/stdlib/ml/``): LSH KNN classifiers.
+The dense TPU-native KNN index lives in ``pathway_tpu.ops.knn`` /
+``stdlib.indexing`` — classifiers here are the sub-linear LSH pruning path."""
+
+from pathway_tpu.stdlib.ml import classifiers
+
+__all__ = ["classifiers"]

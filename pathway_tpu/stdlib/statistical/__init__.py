@@ -1,1 +1,5 @@
-"""Placeholder — implemented in a later milestone."""
+"""Statistical stdlib (reference: ``python/pathway/stdlib/statistical/``)."""
+
+from pathway_tpu.stdlib.statistical._interpolate import InterpolateMode, interpolate
+
+__all__ = ["InterpolateMode", "interpolate"]

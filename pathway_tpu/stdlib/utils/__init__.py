@@ -1,1 +1,24 @@
-"""Placeholder — implemented in a later milestone."""
+"""Utility stdlib (reference: ``python/pathway/stdlib/utils/``)."""
+
+from pathway_tpu.stdlib.utils import bucketing, col, filtering
+from pathway_tpu.stdlib.utils.async_transformer import AsyncTransformer
+from pathway_tpu.stdlib.utils.col import (
+    apply_all_rows,
+    groupby_reduce_majority,
+    multiapply_all_rows,
+    unpack_col,
+)
+from pathway_tpu.stdlib.utils.filtering import argmax_rows, argmin_rows
+
+__all__ = [
+    "AsyncTransformer",
+    "apply_all_rows",
+    "argmax_rows",
+    "argmin_rows",
+    "bucketing",
+    "col",
+    "filtering",
+    "groupby_reduce_majority",
+    "multiapply_all_rows",
+    "unpack_col",
+]

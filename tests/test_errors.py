@@ -91,8 +91,7 @@ def test_error_poisons_through_join_and_groupby_with_retraction():
         k=mapped.k, q=mapped.q, w=right.w
     )
     g = j.groupby(j.k).reduce(j.k, s=pw.reducers.sum(j.q))
-    out = rows_of.__wrapped__(g) if hasattr(rows_of, "__wrapped__") else None
-    # run under poison mode via debug capture (module default policy)
+    # runs under poison mode via debug capture (module default policy)
     final = rows_of(g)
     # after the retraction of the bad row, group 1 holds only q=25; group 2 q=20
     assert final == {(1, 25): 1, (2, 20): 1}, final

@@ -26,20 +26,8 @@ def _pipeline():
     pw.io.subscribe(g, on_change=lambda **k: None)
 
 
-def test_http_server_status_and_metrics(free_tcp_port=20123):
-    import os
-
+def test_http_server_status_and_metrics():
     _pipeline()
-    os.environ["PATHWAY_MONITORING_HTTP_PORT"] = "0"  # ephemeral port
-
-    captured = {}
-    orig_run = pw.internals.run.Runtime.run
-
-    def slow_run(self, outputs):
-        captured["runtime"] = self
-        return orig_run(self, outputs)
-
-    # probe the endpoints mid-run by hooking the runtime loop via a thread
     from pathway_tpu.internals.monitoring import MonitoringHttpServer
 
     class RT:  # minimal runtime facade for the server
@@ -71,10 +59,8 @@ def test_http_server_status_and_metrics(free_tcp_port=20123):
         srv.stop()
 
 
-def test_with_http_server_serves_during_run():
-    import os
-
-    os.environ["PATHWAY_MONITORING_HTTP_PORT"] = "20345"
+def test_with_http_server_serves_during_run(monkeypatch):
+    monkeypatch.setenv("PATHWAY_MONITORING_HTTP_PORT", "20345")
     G.clear()
 
     class Slow(pw.io.python.ConnectorSubject):
