@@ -99,6 +99,15 @@ class GradualBroadcastNode(Node):
                         )
                 else:
                     self.triplet = new_triplet
+                    if len(self.keys_sorted):
+                        # rows that arrived before the first triplet emit now
+                        out.append(
+                            self._emit(
+                                self.keys_sorted,
+                                np.ones(len(self.keys_sorted), dtype=np.int64),
+                                time,
+                            )
+                        )
         if main_batch is not None and len(main_batch):
             ins = main_batch.keys[main_batch.diffs > 0]
             dels = main_batch.keys[main_batch.diffs < 0]

@@ -2,8 +2,8 @@
 
 Dataflow shape: training vectors flatten into (band, bucket) rows; queries
 bucket the same way and equi-join on the band hash, giving per-query candidate
-sets that stay incremental under training-data updates. Distances over the
-candidate set run as one vectorized numpy kernel per query row (the dense
+sets that stay incremental under training-data updates. Each query row's
+candidate set resolves with ONE (n_candidates, d) distance kernel (the dense
 brute-force TPU path lives in ``ops/knn.py``; LSH is the sub-linear candidate
 pruner for huge training sets).
 """
@@ -62,35 +62,30 @@ def knn_lsh_generic_classifier_train(data: pw.Table, bucketer, distance=_euclide
             query=raw_hits.query, candidate=raw_hits.candidate
         )
 
-        def dist_of(qv, cv):
-            return float(
-                distance(
-                    np.atleast_2d(np.asarray(cv, dtype=np.float64)),
-                    np.asarray(qv, dtype=np.float64),
-                )[0]
-            )
-
         gathered = hits.select(
             query=hits.query,
             candidate=hits.candidate,
             qv=queries.ix(hits.query).data,
             cv=data.ix(hits.candidate).data,
         )
-        pairs = gathered.select(
+        grouped = gathered.groupby(gathered.query).reduce(
             query=gathered.query,
-            scored=pw.apply(
-                lambda qv, cv, c: (dist_of(qv, cv), c),
-                gathered.qv,
-                gathered.cv,
-                gathered.candidate,
-            ),
+            qv=pw.reducers.any(gathered.qv),
+            cands=pw.reducers.tuple(gathered.candidate),
+            vecs=pw.reducers.tuple(gathered.cv),
         )
-        ranked = pairs.groupby(pairs.query).reduce(
-            query=pairs.query, scored=pw.reducers.sorted_tuple(pairs.scored)
-        )
-        rekeyed = ranked.with_id(ranked.query)
+
+        def topk(qv, cands, vecs):
+            # ONE (n_candidates, d) distance kernel per query row; ties break
+            # by candidate id so results are worker-layout independent
+            mat = np.stack([np.asarray(v, dtype=np.float64) for v in vecs])
+            dists = distance(mat, np.asarray(qv, dtype=np.float64))
+            order = np.lexsort((np.asarray(cands, dtype=np.uint64), dists))[:k]
+            return tuple(cands[i] for i in order)
+
+        rekeyed = grouped.with_id(grouped.query)
         knns = rekeyed.select(
-            knns_ids=pw.apply(lambda ps: tuple(c for _d, c in ps[:k]), rekeyed.scored)
+            knns_ids=pw.apply(topk, rekeyed.qv, rekeyed.cands, rekeyed.vecs)
         )
         # queries with zero candidates still get a row (empty tuple)
         return queries.select(knns_ids=()).update_rows(knns)

@@ -163,7 +163,16 @@ class AsyncTransformer:
         return bad.select(**{n: bad[n] for n in names})
 
     def with_options(self, **kwargs) -> "AsyncTransformer":
-        return self  # capacity/retry/cache strategies: accepted, not yet used
+        if kwargs:
+            import warnings
+
+            warnings.warn(
+                "AsyncTransformer.with_options: "
+                f"{sorted(kwargs)} are not implemented yet and have NO effect "
+                "(no retries, no capacity limit, no caching)",
+                stacklevel=2,
+            )
+        return self
 
     # -- internals ----------------------------------------------------------
     def _start_loop(self) -> None:
@@ -198,7 +207,16 @@ class AsyncTransformer:
                 try:
                     result = await self.invoke(**row)
                     values = tuple(result.get(n) for n in out_names) + (_SUCCESS,)
-                except Exception:
+                except Exception as e:
+                    import traceback
+
+                    from pathway_tpu.internals.error_log import log_error
+
+                    log_error(
+                        -1,
+                        f"AsyncTransformer.invoke failed: {e!r}",
+                        traceback.format_exc(),
+                    )
                     values = tuple(None for _ in out_names) + (_FAILURE,)
                 self._subject.push_result(key, values)
                 self._completed += 1

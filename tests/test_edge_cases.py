@@ -1,0 +1,276 @@
+"""Edge-case suites demanded by VERDICT r2 #10 — modeled on the reference's
+``python/pathway/tests/temporal/`` late-data/behavior cases,
+``test_table_operations`` outer-join universe cases, and
+``test_http_server.py`` (a real REST round-trip)."""
+
+import json
+import threading
+import time
+import urllib.request
+
+import pytest
+
+import pathway_tpu as pw
+from pathway_tpu.internals.parse_graph import G
+
+from utils import deltas_of, rows_of
+
+
+# ----------------------------------------------------------- temporal late data
+
+
+def _kv_stream(rows):
+    """rows: (t_value, v, logical_time, diff)."""
+    lines = ["t | v | __time__ | __diff__"]
+    lines += [f"{t} | {v} | {lt} | {d}" for (t, v, lt, d) in rows]
+    return pw.debug.table_from_markdown("\n".join(lines))
+
+
+def test_window_cutoff_drops_late_data():
+    # watermark advances to 30; a late row for the first window arrives after
+    # the cutoff and must NOT change the emitted aggregate
+    tbl = _kv_stream(
+        [
+            (1, 10, 2, 1),
+            (2, 20, 2, 1),
+            (25, 1, 4, 1),   # pushes watermark far past window [0, 10)
+            (3, 99, 6, 1),   # late for [0, 10): beyond cutoff -> ignored
+        ]
+    )
+    w = tbl.windowby(
+        tbl.t,
+        window=pw.temporal.tumbling(duration=10),
+        behavior=pw.temporal.common_behavior(cutoff=5),
+    ).reduce(end=pw.this._pw_window_end, s=pw.reducers.sum(pw.this.v))
+    assert rows_of(w) == {(10, 30): 1, (30, 1): 1}
+
+
+def test_window_without_cutoff_accepts_late_data():
+    tbl = _kv_stream(
+        [
+            (1, 10, 2, 1),
+            (25, 1, 4, 1),
+            (3, 99, 6, 1),  # late but no behavior -> applied
+        ]
+    )
+    w = tbl.windowby(tbl.t, window=pw.temporal.tumbling(duration=10)).reduce(
+        end=pw.this._pw_window_end, s=pw.reducers.sum(pw.this.v)
+    )
+    assert rows_of(w) == {(10, 109): 1, (30, 1): 1}
+
+
+def test_window_delay_batches_updates():
+    # delay=10 holds window [0,10) results until watermark reaches start+10;
+    # the two early rows then emit as ONE aggregate (no intermediate result)
+    tbl = _kv_stream(
+        [
+            (1, 10, 2, 1),
+            (2, 20, 4, 1),
+            (15, 1, 6, 1),  # watermark 15 >= 0+10: window releases
+        ]
+    )
+    w = tbl.windowby(
+        tbl.t,
+        window=pw.temporal.tumbling(duration=10),
+        behavior=pw.temporal.common_behavior(delay=10),
+    ).reduce(end=pw.this._pw_window_end, s=pw.reducers.sum(pw.this.v))
+    ds = deltas_of(w)
+    first_window_emits = [d for d in ds if d[3][0] == 10 and d[2] > 0]
+    # exactly one insertion for the [0,10) window, already containing both rows
+    assert [d[3] for d in first_window_emits] == [(10, 30)], ds
+
+
+def test_window_keep_results_false_forgets_old_windows():
+    tbl = _kv_stream(
+        [
+            (1, 10, 2, 1),
+            (25, 1, 4, 1),   # watermark 25: window [0,10) past cutoff
+            (45, 2, 6, 1),   # watermark 45: window [20,30) past cutoff too
+        ]
+    )
+    w = tbl.windowby(
+        tbl.t,
+        window=pw.temporal.tumbling(duration=10),
+        behavior=pw.temporal.common_behavior(cutoff=5, keep_results=False),
+    ).reduce(end=pw.this._pw_window_end, s=pw.reducers.sum(pw.this.v))
+    # only the newest window survives in the final state
+    assert rows_of(w) == {(50, 2): 1}
+
+
+def test_interval_join_with_behavior_ignores_late_left_row():
+    left = _kv_stream(
+        [
+            (2, 1, 2, 1),
+            (30, 2, 4, 1),   # watermark forward
+            (3, 3, 8, 1),    # late: within join reach of right t=4 but cut off
+        ]
+    )
+    right = pw.debug.table_from_markdown(
+        """
+        t | w | __time__ | __diff__
+        4 | 100 | 2 | 1
+        31 | 200 | 4 | 1
+        """
+    )
+    j_nobehavior = left.interval_join(
+        right, left.t, right.t, pw.temporal.interval(-2, 2)
+    ).select(v=left.v, w=right.w)
+    assert rows_of(j_nobehavior) == {(1, 100): 1, (2, 200): 1, (3, 100): 1}
+
+    G.clear()
+    left2 = _kv_stream(
+        [
+            (2, 1, 2, 1),
+            (30, 2, 4, 1),
+            (3, 3, 8, 1),
+        ]
+    )
+    right2 = pw.debug.table_from_markdown(
+        """
+        t | w | __time__ | __diff__
+        4 | 100 | 2 | 1
+        31 | 200 | 4 | 1
+        """
+    )
+    j = left2.interval_join(
+        right2,
+        left2.t,
+        right2.t,
+        pw.temporal.interval(-2, 2),
+        behavior=pw.temporal.common_behavior(cutoff=10),
+    ).select(v=left2.v, w=right2.w)
+    assert rows_of(j) == {(1, 100): 1, (2, 200): 1}
+
+
+# -------------------------------------------------------- outer-join universes
+
+
+class _L(pw.Schema):
+    k: int
+    v: int
+
+
+class _R(pw.Schema):
+    k: int
+    w: int
+
+
+def test_outer_join_padded_rows_feed_groupby():
+    left = pw.debug.table_from_rows(_L, [(1, 10), (2, 20), (3, 30)])
+    right = pw.debug.table_from_rows(_R, [(1, 100), (9, 900)])
+    j = left.join_outer(right, left.k == right.k).select(
+        k=pw.coalesce(left.k, right.k), w=right.w
+    )
+    g = j.groupby(j.w).reduce(w=j.w, c=pw.reducers.count())
+    # two left rows pad with w=None and group together
+    assert rows_of(g) == {(None, 2): 1, (100, 1): 1, (900, 1): 1}
+
+
+def test_chained_outer_joins():
+    a = pw.debug.table_from_rows(pw.schema_from_types(k=int, a=int), [(1, 1), (2, 2)])
+    b = pw.debug.table_from_rows(pw.schema_from_types(k=int, b=int), [(2, 20), (3, 30)])
+    c = pw.debug.table_from_rows(pw.schema_from_types(k=int, c=int), [(3, 300), (1, 100)])
+    ab = a.join_outer(b, a.k == b.k).select(
+        k=pw.coalesce(a.k, b.k), a=a.a, b=b.b
+    )
+    abc = ab.join_outer(c, ab.k == c.k).select(
+        k=pw.coalesce(ab.k, c.k), a=ab.a, b=ab.b, c=c.c
+    )
+    assert rows_of(abc) == {
+        (1, 1, None, 100): 1,
+        (2, 2, 20, None): 1,
+        (3, None, 30, 300): 1,
+    }
+
+
+def test_outer_join_none_keys_match_as_values():
+    """Join keys follow the reference's Value semantics (None == None matches),
+    not SQL NULL semantics — differential hashes None like any other value."""
+    from typing import Optional
+
+    left = pw.debug.table_from_rows(
+        pw.schema_from_types(k=Optional[int], v=int), [(None, 1), (1, 2)]
+    )
+    right = pw.debug.table_from_rows(
+        pw.schema_from_types(k=Optional[int], w=int), [(None, 10), (1, 20)]
+    )
+    j = left.join_outer(right, left.k == right.k).select(v=left.v, w=right.w)
+    assert rows_of(j) == {(1, 10): 1, (2, 20): 1}
+
+
+def test_left_join_then_filter_restores_subuniverse():
+    left = pw.debug.table_from_rows(_L, [(1, 10), (2, 20)])
+    right = pw.debug.table_from_rows(_R, [(1, 100)])
+    j = left.join_left(right, left.k == right.k).select(
+        k=left.k, v=left.v, w=right.w
+    )
+    matched = j.filter(j.w.is_not_none())
+    g = matched.groupby(matched.k).reduce(matched.k, s=pw.reducers.sum(matched.w))
+    assert rows_of(g) == {(1, 100): 1}
+
+
+def test_outer_join_streaming_universe_consistency():
+    """The padded row's id must be stable across its appear/retract cycle."""
+    left = pw.debug.table_from_markdown(
+        """
+        k | v | __time__ | __diff__
+        1 | 10 | 2 | 1
+        """
+    )
+    right = pw.debug.table_from_markdown(
+        """
+        k | w | __time__ | __diff__
+        1 | 100 | 4 | 1
+        1 | 100 | 6 | -1
+        """
+    )
+    j = left.join_left(right, left.k == right.k).select(v=left.v, w=right.w)
+    ds = deltas_of(j)
+    pad_inserts = [d for d in ds if d[3] == (10, None) and d[2] > 0]
+    pad_retracts = [d for d in ds if d[3] == (10, None) and d[2] < 0]
+    # pad appears at t=2, retracts at t=4 (match found), reappears at t=6
+    assert len(pad_inserts) == 2 and len(pad_retracts) == 1
+    keys = {d[1] for d in pad_inserts} | {d[1] for d in pad_retracts}
+    assert len(keys) == 1, "padded row id changed across its lifecycle"
+    assert rows_of(j) == {(10, None): 1}
+
+
+# ----------------------------------------------------------------- REST server
+
+
+def test_rest_server_round_trip():
+    G.clear()
+
+    class QuerySchema(pw.Schema):
+        query: str
+
+    queries, respond = pw.io.http.rest_connector(
+        host="127.0.0.1", port=28913, schema=QuerySchema, delete_completed_queries=True
+    )
+    answers = queries.select(result=pw.apply(lambda q: q.upper(), queries.query))
+    respond(answers)
+
+    results = {}
+
+    def client():
+        for attempt in range(50):
+            try:
+                req = urllib.request.Request(
+                    "http://127.0.0.1:28913/",
+                    data=json.dumps({"query": "hello"}).encode(),
+                    headers={"Content-Type": "application/json"},
+                )
+                results["answer"] = json.loads(urllib.request.urlopen(req, timeout=5).read())
+                break
+            except Exception as e:  # server may not be up yet
+                results["error"] = repr(e)
+                time.sleep(0.1)
+        rt = pw.internals.run.current_runtime()
+        if rt is not None:
+            rt.request_stop()
+
+    th = threading.Thread(target=client)
+    th.start()
+    pw.run(monitoring_level="none")
+    th.join()
+    assert results.get("answer") == "HELLO", results

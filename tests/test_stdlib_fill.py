@@ -208,3 +208,26 @@ def test_interpolate_float_column_nan_as_missing():
     )
     r = t.interpolate(pw.this.ts, pw.this.v)
     assert sorted(rows_of(r).elements()) == [(1, 2.0), (2, 4.0), (3, 6.0), (4, 8.0)]
+
+
+def test_gradual_broadcast_rows_before_first_triplet():
+    """Rows present before the first threshold triplet must still get values."""
+    t = pw.debug.table_from_rows(pw.schema_from_types(v=int), [(i,) for i in range(50)])
+    stream = [(0.0, 10.0, 10.0, 4, 1)]  # triplet arrives at t=4, rows at t=0
+    thr = pw.debug.table_from_rows(
+        pw.schema_from_types(lower=float, value=float, upper=float), stream, is_stream=True
+    )
+    b = t._gradual_broadcast(thr, thr.lower, thr.value, thr.upper)
+    counts = collections.Counter(r[1] for r in rows_of(b).elements())
+    assert counts == {10.0: 50}, counts
+
+
+def test_async_transformer_failure_reaches_error_log():
+    from pathway_tpu.internals.error_log import _entries
+
+    G.clear()
+    inp = pw.debug.table_from_rows(pw.schema_from_types(value=int), [(-5,)])
+    tr = _Inc(input_table=inp)
+    pw.io.subscribe(tr.failed, on_change=lambda **k: None)
+    pw.run(monitoring_level="none")
+    assert any("AsyncTransformer.invoke failed" in m for (_o, m, _t) in _entries)
