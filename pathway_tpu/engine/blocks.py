@@ -67,10 +67,17 @@ class DeltaBatch:
     def negated(self) -> "DeltaBatch":
         return DeltaBatch(self.keys, -self.diffs, self.data, self.time)
 
-    def rows(self) -> Iterable[tuple[np.uint64, int, tuple]]:
-        cols = list(self.data.values())
-        for i in range(len(self.keys)):
-            yield self.keys[i], int(self.diffs[i]), tuple(c[i] for c in cols)
+    def rows(self) -> Iterable[tuple[int, int, tuple]]:
+        # columnar → row tuples via one zip transpose (not a per-cell genexpr);
+        # keys/diffs come out as python ints
+        keys = self.keys.tolist()
+        diffs = self.diffs.tolist()
+        if self.data:
+            yield from zip(keys, diffs, zip(*(column_to_list(c) for c in self.data.values())))
+        else:
+            empty = ()
+            for k, d in zip(keys, diffs):
+                yield k, d, empty
 
     def row_digest(self) -> np.ndarray:
         """uint64 digest of each row's values (keys excluded)."""

@@ -155,8 +155,11 @@ class _TimedInputNode(ops.StreamInputNode):
             emit_until += 1
         if emit_until == self.idx:
             return []
-        for t, key, values, diff in self.events[self.idx : emit_until]:
-            self.push(key, values, diff)
+        # one lock + extend for the whole tick's slice, not a lock per event
+        self.push_many(
+            (key, values, diff)
+            for (_t, key, values, diff) in self.events[self.idx : emit_until]
+        )
         self.idx = emit_until
         return super().poll(time)
 

@@ -36,6 +36,11 @@ class EncoderConfig(NamedTuple):
     d_ff: int = 1536
     max_len: int = 512
     dtype: Any = jnp.bfloat16
+    #: "preln" = this framework's native pre-LN block; "bert" = the exact
+    #: post-LN BERT/MiniLM block (biases + embedding LayerNorm + token types),
+    #: used when loading real HuggingFace checkpoints via ``from_pretrained``
+    arch: str = "preln"
+    ln_eps: float = 1e-6
 
 
 def init_params(cfg: EncoderConfig, key: jax.Array) -> dict:
@@ -69,7 +74,8 @@ def init_params(cfg: EncoderConfig, key: jax.Array) -> dict:
 
 def param_shardings(cfg: EncoderConfig, mesh: Mesh) -> dict:
     """PartitionSpecs mirroring init_params' tree: Megatron column/row split on the
-    'model' axis; embeddings sharded on vocab; everything tiny replicated."""
+    'model' axis; embeddings sharded on vocab; everything tiny replicated.
+    Mirrors whichever architecture the config selects (preln or bert)."""
     def ns(*spec):
         return NamedSharding(mesh, P(*spec))
 
@@ -81,12 +87,24 @@ def param_shardings(cfg: EncoderConfig, mesh: Mesh) -> dict:
         "w1": ns(None, "model"),
         "w2": ns("model", None),
     }
-    return {
+    if cfg.arch == "bert":
+        layer = dict(
+            layer,
+            bqkv=ns("model"),  # column-parallel bias
+            bo=ns(),
+            b1=ns("model"),
+            b2=ns(),
+        )
+    out = {
         "embed": ns("model", None),
         "pos": ns(),
         "layers": [layer for _ in range(cfg.n_layers)],
         "ln_f": {"g": ns(), "b": ns()},
     }
+    if cfg.arch == "bert":
+        out["tok_type"] = ns()
+        out["emb_ln"] = {"g": ns(), "b": ns()}
+    return out
 
 
 def _layer_norm(x, g, b):
@@ -116,8 +134,74 @@ def _attention(x, wqkv, wo, mask, n_heads):
                       preferred_element_type=jnp.float32).astype(x.dtype)
 
 
+def _encode_bert(params: dict, cfg: EncoderConfig, token_ids: jax.Array, mask: jax.Array) -> jax.Array:
+    """Exact BERT/MiniLM forward (post-LN, biased projections, embedding LN),
+    so HuggingFace checkpoints reproduce their reference embeddings
+    (``xpacks/llm/embedders.py:340-398`` SentenceTransformer semantics:
+    masked mean pooling + L2 norm)."""
+    dt_ = cfg.dtype
+    L = token_ids.shape[1]
+    x = (
+        params["embed"][token_ids]
+        + params["pos"][:L][None, :, :]
+        + params["tok_type"][0][None, None, :]
+    )
+    x = _layer_norm_eps(x, params["emb_ln"]["g"], params["emb_ln"]["b"], cfg.ln_eps).astype(dt_)
+    for layer in params["layers"]:
+        a = _attention_biased(
+            x, layer["wqkv"], layer["bqkv"], layer["wo"], layer["bo"], mask, cfg.n_heads
+        )
+        x = _layer_norm_eps(
+            (x + a).astype(jnp.float32), layer["ln1"]["g"], layer["ln1"]["b"], cfg.ln_eps
+        ).astype(dt_)
+        h = jnp.einsum("bld,df->blf", x, layer["w1"].astype(dt_),
+                       preferred_element_type=jnp.float32) + layer["b1"]
+        h = jax.nn.gelu(h.astype(jnp.float32), approximate=False).astype(dt_)
+        h = jnp.einsum("blf,fd->bld", h, layer["w2"].astype(dt_),
+                       preferred_element_type=jnp.float32) + layer["b2"]
+        x = _layer_norm_eps(
+            x.astype(jnp.float32) + h, layer["ln2"]["g"], layer["ln2"]["b"], cfg.ln_eps
+        ).astype(dt_)
+    m = mask.astype(jnp.float32)[:, :, None]
+    pooled = jnp.sum(x.astype(jnp.float32) * m, axis=1) / jnp.maximum(jnp.sum(m, axis=1), 1.0)
+    return pooled / jnp.maximum(jnp.linalg.norm(pooled, axis=-1, keepdims=True), 1e-12)
+
+
+def _layer_norm_eps(x, g, b, eps):
+    x32 = x.astype(jnp.float32)
+    mu = jnp.mean(x32, axis=-1, keepdims=True)
+    var = jnp.var(x32, axis=-1, keepdims=True)
+    return (x32 - mu) * jax.lax.rsqrt(var + eps) * g + b
+
+
+def _attention_biased(x, wqkv, bqkv, wo, bo, mask, n_heads):
+    B, L, D = x.shape
+    qkv = (
+        jnp.einsum("bld,de->ble", x, wqkv.astype(x.dtype),
+                   preferred_element_type=jnp.float32) + bqkv
+    ).astype(x.dtype)
+    q, k, v = jnp.split(qkv, 3, axis=-1)
+    hd = D // n_heads
+    q = q.reshape(B, L, n_heads, hd).transpose(0, 2, 1, 3)
+    k = k.reshape(B, L, n_heads, hd).transpose(0, 2, 1, 3)
+    v = v.reshape(B, L, n_heads, hd).transpose(0, 2, 1, 3)
+    scores = jnp.einsum("bhqd,bhkd->bhqk", q, k,
+                        preferred_element_type=jnp.float32) * (hd ** -0.5)
+    scores = jnp.where(mask[:, None, None, :], scores, -1e30)
+    probs = jax.nn.softmax(scores, axis=-1).astype(x.dtype)
+    ctx = jnp.einsum("bhqk,bhkd->bhqd", probs, v,
+                     preferred_element_type=jnp.float32).astype(x.dtype)
+    ctx = ctx.transpose(0, 2, 1, 3).reshape(B, L, D)
+    return (
+        jnp.einsum("bld,de->ble", ctx, wo.astype(x.dtype),
+                   preferred_element_type=jnp.float32) + bo
+    ).astype(x.dtype)
+
+
 def encode(params: dict, cfg: EncoderConfig, token_ids: jax.Array, mask: jax.Array) -> jax.Array:
     """Forward pass: [B, L] int32 tokens + bool mask → [B, d_model] f32 unit vectors."""
+    if cfg.arch == "bert":
+        return _encode_bert(params, cfg, token_ids, mask)
     x = params["embed"][token_ids].astype(cfg.dtype)
     L = token_ids.shape[1]
     x = x + params["pos"][:L][None, :, :].astype(cfg.dtype)
@@ -204,6 +288,109 @@ class HashTokenizer:
         return ids, mask
 
 
+class WordPieceTokenizer:
+    """Greedy longest-match-first WordPiece (the BERT/MiniLM tokenizer;
+    reference embedders tokenize through HuggingFace — ``embedders.py:340``).
+    Vocabulary loads from a standard ``vocab.txt`` (one token per line,
+    ``##``-prefixed continuations)."""
+
+    def __init__(
+        self,
+        vocab: dict,
+        max_len: int = 128,
+        lowercase: bool = True,
+        unk_token: str = "[UNK]",
+        cls_token: str = "[CLS]",
+        sep_token: str = "[SEP]",
+        max_word_chars: int = 100,
+    ):
+        self.vocab = vocab
+        self.max_len = max_len
+        self.lowercase = lowercase
+        self.unk_id = vocab[unk_token]
+        self.cls_id = vocab[cls_token]
+        self.sep_id = vocab[sep_token]
+        self.max_word_chars = max_word_chars
+
+    @classmethod
+    def from_vocab_file(cls, path: str, **kwargs) -> "WordPieceTokenizer":
+        vocab: dict = {}
+        with open(path, encoding="utf-8") as f:
+            for i, line in enumerate(f):
+                vocab[line.rstrip("\r\n")] = i
+        return cls(vocab, **kwargs)
+
+    def _basic(self, text: str) -> list:
+        if self.lowercase:
+            import unicodedata
+
+            text = unicodedata.normalize("NFD", text.lower())
+            text = "".join(c for c in text if unicodedata.category(c) != "Mn")
+        out: list = []
+        word = []
+        for ch in text:
+            if ch.isspace():
+                if word:
+                    out.append("".join(word))
+                    word = []
+            elif not ch.isalnum():
+                if word:
+                    out.append("".join(word))
+                    word = []
+                out.append(ch)
+            else:
+                word.append(ch)
+        if word:
+            out.append("".join(word))
+        return out
+
+    def _wordpiece(self, word: str) -> list:
+        if len(word) > self.max_word_chars:
+            return [self.unk_id]
+        ids: list = []
+        start = 0
+        while start < len(word):
+            end = len(word)
+            cur = None
+            while start < end:
+                piece = word[start:end]
+                if start > 0:
+                    piece = "##" + piece
+                if piece in self.vocab:
+                    cur = self.vocab[piece]
+                    break
+                end -= 1
+            if cur is None:
+                return [self.unk_id]  # any unmatchable span voids the word
+            ids.append(cur)
+            start = end
+        return ids
+
+    def _tok(self, text: str) -> list:
+        ids: list = []
+        for word in self._basic(text):
+            ids.extend(self._wordpiece(word))
+            if len(ids) >= self.max_len - 2:
+                break
+        return ids[: self.max_len - 2]
+
+    def __call__(self, texts: list) -> tuple:
+        toks = [[self.cls_id] + self._tok(t) + [self.sep_id] for t in texts]
+        from pathway_tpu.ops.microbatch import bucket_size
+
+        L = min(
+            self.max_len,
+            bucket_size(max((len(t) for t in toks), default=1), min_bucket=16),
+        )
+        ids = np.zeros((len(toks), L), dtype=np.int32)
+        mask = np.zeros((len(toks), L), dtype=bool)
+        for i, t in enumerate(toks):
+            t = t[:L]
+            ids[i, : len(t)] = t
+            mask[i, : len(t)] = True
+        return ids, mask
+
+
 class JaxSentenceEncoder:
     """Batched text → embedding model: tokenizer + jitted transformer forward.
 
@@ -216,10 +403,12 @@ class JaxSentenceEncoder:
         cfg: EncoderConfig | None = None,
         seed: int = 0,
         mesh: Mesh | None = None,
+        params: dict | None = None,
+        tokenizer: Any = None,
     ):
         self.cfg = cfg or EncoderConfig()
-        self.params = init_params(self.cfg, jax.random.PRNGKey(seed))
-        self.tokenizer = HashTokenizer(self.cfg.vocab_size, self.cfg.max_len)
+        self.params = params if params is not None else init_params(self.cfg, jax.random.PRNGKey(seed))
+        self.tokenizer = tokenizer or HashTokenizer(self.cfg.vocab_size, self.cfg.max_len)
         if mesh is not None:
             self.params = jax.tree.map(
                 lambda p, s: jax.device_put(p, s),
@@ -237,5 +426,146 @@ class JaxSentenceEncoder:
         ids, mask = self.tokenizer(texts)
         return np.asarray(encode_jit(self.params, self.cfg, ids, mask))
 
+    def encode_texts_device(self, texts: list[str]) -> jax.Array:
+        """Like ``encode_texts`` but returns the device array without syncing —
+        chain into device-consuming ops (e.g. ``BruteForceKnnIndex.
+        add_batch_device``) to keep a whole ingest pipeline async."""
+        ids, mask = self.tokenizer(texts)
+        return encode_jit(self.params, self.cfg, ids, mask)
+
     def encode_tokens(self, ids: np.ndarray, mask: np.ndarray) -> np.ndarray:
         return np.asarray(encode_jit(self.params, self.cfg, ids, mask))
+
+    @classmethod
+    def from_pretrained(
+        cls,
+        path: str,
+        *,
+        max_len: int | None = None,
+        mesh: Mesh | None = None,
+        dtype: Any = None,
+    ) -> "JaxSentenceEncoder":
+        """Load a HuggingFace BERT/MiniLM checkpoint directory (``config.json``
+        + ``model.safetensors``/``pytorch_model.bin`` [+ ``vocab.txt``]) into
+        the exact-BERT forward path, reproducing the reference
+        SentenceTransformerEmbedder's embeddings on TPU
+        (``xpacks/llm/embedders.py:340-398``)."""
+        import json
+        import os
+
+        with open(os.path.join(path, "config.json"), encoding="utf-8") as f:
+            hf = json.load(f)
+        cfg = EncoderConfig(
+            vocab_size=hf["vocab_size"],
+            d_model=hf["hidden_size"],
+            n_heads=hf["num_attention_heads"],
+            n_layers=hf["num_hidden_layers"],
+            d_ff=hf["intermediate_size"],
+            max_len=min(hf.get("max_position_embeddings", 512), max_len or 512),
+            dtype=dtype if dtype is not None else jnp.float32,
+            arch="bert",
+            ln_eps=hf.get("layer_norm_eps", 1e-12),
+        )
+        sd = _load_state_dict(path)
+
+        def get(name):
+            for prefix in ("", "bert."):
+                if prefix + name in sd:
+                    return jnp.asarray(np.asarray(sd[prefix + name]), dtype=jnp.float32)
+            raise KeyError(f"missing checkpoint tensor {name!r}")
+
+        params: dict = {
+            "embed": get("embeddings.word_embeddings.weight"),
+            "pos": get("embeddings.position_embeddings.weight"),
+            "tok_type": get("embeddings.token_type_embeddings.weight"),
+            "emb_ln": {
+                "g": get("embeddings.LayerNorm.weight"),
+                "b": get("embeddings.LayerNorm.bias"),
+            },
+            "layers": [],
+            "ln_f": {"g": jnp.ones((cfg.d_model,)), "b": jnp.zeros((cfg.d_model,))},
+        }
+        for i in range(cfg.n_layers):
+            pre = f"encoder.layer.{i}."
+            wq = get(pre + "attention.self.query.weight").T
+            wk = get(pre + "attention.self.key.weight").T
+            wv = get(pre + "attention.self.value.weight").T
+            bq = get(pre + "attention.self.query.bias")
+            bk = get(pre + "attention.self.key.bias")
+            bv = get(pre + "attention.self.value.bias")
+            params["layers"].append(
+                {
+                    "wqkv": jnp.concatenate([wq, wk, wv], axis=1),
+                    "bqkv": jnp.concatenate([bq, bk, bv]),
+                    "wo": get(pre + "attention.output.dense.weight").T,
+                    "bo": get(pre + "attention.output.dense.bias"),
+                    "ln1": {
+                        "g": get(pre + "attention.output.LayerNorm.weight"),
+                        "b": get(pre + "attention.output.LayerNorm.bias"),
+                    },
+                    "w1": get(pre + "intermediate.dense.weight").T,
+                    "b1": get(pre + "intermediate.dense.bias"),
+                    "w2": get(pre + "output.dense.weight").T,
+                    "b2": get(pre + "output.dense.bias"),
+                    "ln2": {
+                        "g": get(pre + "output.LayerNorm.weight"),
+                        "b": get(pre + "output.LayerNorm.bias"),
+                    },
+                }
+            )
+        lowercase = hf.get("do_lower_case", True)
+        vocab_path = os.path.join(path, "vocab.txt")
+        tok_json = os.path.join(path, "tokenizer.json")
+        tokenizer: Any
+        if os.path.exists(vocab_path):
+            tokenizer = WordPieceTokenizer.from_vocab_file(
+                vocab_path, max_len=cfg.max_len, lowercase=lowercase
+            )
+        elif os.path.exists(tok_json):
+            with open(tok_json, encoding="utf-8") as f:
+                vocab = json.load(f)["model"]["vocab"]
+            tokenizer = WordPieceTokenizer(
+                vocab, max_len=cfg.max_len, lowercase=lowercase
+            )
+        else:
+            import warnings
+
+            warnings.warn(
+                f"{path!r} has neither vocab.txt nor tokenizer.json: falling "
+                "back to the hash tokenizer — embeddings will NOT match the "
+                "reference model for these weights",
+                stacklevel=2,
+            )
+            tokenizer = HashTokenizer(cfg.vocab_size, cfg.max_len)
+        return cls(cfg, mesh=mesh, params=params, tokenizer=tokenizer)
+
+
+def _load_state_dict(path: str) -> dict:
+    import os
+
+    st_path = os.path.join(path, "model.safetensors")
+    if os.path.exists(st_path):
+        from safetensors.numpy import load_file
+
+        return load_file(st_path)
+    bin_path = os.path.join(path, "pytorch_model.bin")
+    if os.path.exists(bin_path):
+        import torch
+
+        sd = torch.load(bin_path, map_location="cpu", weights_only=True)
+        return {k: v.numpy() for k, v in sd.items()}
+    raise FileNotFoundError(
+        f"no model.safetensors or pytorch_model.bin under {path!r}"
+    )
+
+
+def encoder_flops_per_doc(cfg: EncoderConfig, seq_len: int) -> float:
+    """Matmul FLOPs of one forward pass per document (MFU accounting)."""
+    d, f, L = cfg.d_model, cfg.d_ff, seq_len
+    per_layer = (
+        2 * L * d * (3 * d)      # qkv projection
+        + 2 * L * d * d          # output projection
+        + 2 * 2 * L * L * d      # attention scores + context
+        + 2 * L * d * f * 2      # feed-forward up + down
+    )
+    return float(cfg.n_layers * per_layer)

@@ -139,6 +139,8 @@ class BruteForceKnnIndex:
         self._pending_slots: list[int] = []
         self._pending_rows: list[np.ndarray] = []
         self._pending_invalidate: list[int] = []
+        # device-resident staged blocks: (slots i32 array, [m, d] jax array)
+        self._pending_device: list[tuple[Any, Any]] = []
 
     def __getstate__(self):
         """Snapshot form: device arrays DMA'd to host (operator persistence
@@ -175,7 +177,7 @@ class BruteForceKnnIndex:
         self._free.extend(range(new - 1, old - 1, -1))
 
     # -- mutation ------------------------------------------------------------
-    def _stage(self, key: Any, vec: np.ndarray) -> None:
+    def _stage_host(self, key: Any, vec: np.ndarray) -> None:
         if key in self._key_to_slot:
             slot = self._key_to_slot[key]  # upsert in place
         else:
@@ -207,6 +209,39 @@ class BruteForceKnnIndex:
         for key, vec in zip(keys, vecs):
             self._stage(key, vec)
 
+    def add_batch_device(self, keys: Sequence[Any], vectors: "jax.Array") -> None:
+        """Bulk add of embeddings that already live in HBM (e.g. straight from
+        the encoder): slots are assigned host-side, the data never leaves the
+        device — under a remote/tunneled chip this keeps the whole ingest loop
+        async with zero per-batch device→host syncs."""
+        m = len(keys)
+        if vectors.shape != (m, self.dimension):
+            raise ValueError(
+                f"vectors shape {vectors.shape} != ({m}, {self.dimension})"
+            )
+        if self._pending_slots:
+            # host rows staged earlier must land first (staging order decides
+            # the upsert winner); apply them before queuing this device block
+            self._flush_host()
+        slots = np.empty(m, dtype=np.int32)
+        for i, key in enumerate(keys):
+            slot = self._key_to_slot.get(key)
+            if slot is None:
+                if not self._free:
+                    self._grow()
+                slot = self._free.pop()
+                self._key_to_slot[key] = slot
+                self._slot_to_key[slot] = key
+            slots[i] = slot
+        if len(np.unique(slots)) != len(slots):
+            # duplicate keys in one call: scatter winners are undefined, keep
+            # the last staging per slot (device-side gather)
+            last = {int(s): i for i, s in enumerate(slots)}
+            keep = sorted(last.values())
+            vectors = vectors[jnp.asarray(keep)]
+            slots = slots[keep]
+        self._pending_device.append((jnp.asarray(slots), vectors))
+
     def remove(self, key: Any) -> None:
         slot = self._key_to_slot.pop(key, None)
         if slot is None:
@@ -215,7 +250,26 @@ class BruteForceKnnIndex:
         self._free.append(slot)
         self._pending_invalidate.append(slot)
 
+    def _stage(self, key: Any, vec: np.ndarray) -> None:
+        if self._pending_device:
+            # keep global application order == staging order
+            self._flush_device()
+        self._stage_host(key, vec)
+
     def _flush(self) -> None:
+        self._flush_host()
+        self._flush_device()
+        if self._pending_invalidate:
+            # a slot may have been re-added after removal; only invalidate slots
+            # that are currently free
+            free = set(self._free)
+            dead = [s for s in self._pending_invalidate if s in free]
+            if dead:
+                slots = jnp.asarray(dead, dtype=jnp.int32)
+                self._valid = _set_valid(self._valid, slots, jnp.zeros(len(dead), bool))
+            self._pending_invalidate = []
+
+    def _flush_host(self) -> None:
         if self._pending_slots:
             # the same slot can be staged twice (upsert within one flush window);
             # jnp scatter with duplicate indices has an undefined winner, so keep
@@ -236,24 +290,37 @@ class BruteForceKnnIndex:
             )
             self._valid = _set_valid(self._valid, slots, jnp.ones(len(slots), bool))
             self._pending_slots, self._pending_rows = [], []
-        if self._pending_invalidate:
-            # a slot may have been re-added after removal; only invalidate slots
-            # that are currently free
-            free = set(self._free)
-            dead = [s for s in self._pending_invalidate if s in free]
-            if dead:
-                slots = jnp.asarray(dead, dtype=jnp.int32)
-                self._valid = _set_valid(self._valid, slots, jnp.zeros(len(dead), bool))
-            self._pending_invalidate = []
+
+    def _flush_device(self) -> None:
+        if self._pending_device:
+            for slots, dev in self._pending_device:
+                dev32 = dev.astype(jnp.float32)
+                self._vectors = _update_slots(
+                    self._vectors, slots, dev.astype(self.dtype)
+                )
+                self._norms_sq = self._norms_sq.at[slots].set(
+                    jnp.sum(dev32 * dev32, axis=-1)
+                )
+                self._valid = _set_valid(
+                    self._valid, slots, jnp.ones(len(dev32), bool)
+                )
+            self._pending_device = []
 
     # -- search --------------------------------------------------------------
     def search(
         self, queries: np.ndarray, k: int
     ) -> list[list[tuple[Any, float]]]:
         """Top-k per query as (key, score) lists, best first. Scores follow the
-        metric's 'higher is better' convention (L2SQ is negated squared dist)."""
+        metric's 'higher is better' convention (L2SQ is negated squared dist).
+        Accepts a device array directly (e.g. from ``encode_texts_device``) so
+        an encode→search chain costs one host round-trip, not two."""
         self._flush()
-        q = jnp.asarray(np.atleast_2d(np.asarray(queries, np.float32)), self.dtype)
+        if isinstance(queries, jax.Array):
+            q = queries.astype(self.dtype)
+            if q.ndim == 1:
+                q = q[None, :]
+        else:
+            q = jnp.asarray(np.atleast_2d(np.asarray(queries, np.float32)), self.dtype)
         if q.shape[-1] != self.dimension:
             raise ValueError(f"query dim {q.shape[-1]} != {self.dimension}")
         kk = min(k, self.capacity)
