@@ -24,6 +24,9 @@ END_OF_STREAM = np.iinfo(np.int64).max  # frontier value after all input closed
 
 SOLO = "solo"  # exchange marker: route every row to worker 0 (serial operator)
 
+BROADCAST = "broadcast"  # exchange marker: deliver every row to EVERY worker
+# (replicated consumers, e.g. index queries fanned out over doc shards)
+
 
 class Node:
     """Engine operator. Subclasses implement ``process`` and optionally

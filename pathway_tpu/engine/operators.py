@@ -134,16 +134,29 @@ class StreamInputNode(Node):
 
 
 class RowwiseNode(Node):
-    """select/with_columns: stateless block program."""
+    """select/with_columns: stateless block program.
+
+    Stateless stages normally process where their input was produced (no
+    exchange). A stage marked ``expensive`` (it runs python/numpy UDFs, e.g.
+    embedders) instead exchanges by row key, spreading the per-row compute
+    across workers — otherwise every UDF chained after a worker-0 source would
+    serialize there (VERDICT r2 #5)."""
 
     name = "rowwise"
 
     def exchange_key(self, port):
+        if self.expensive:
+            return lambda batch: batch.keys
         return None  # stateless: process where produced
 
-    def __init__(self, program: Callable[[DeltaBatch], dict[str, np.ndarray]]):
+    def __init__(
+        self,
+        program: Callable[[DeltaBatch], dict[str, np.ndarray]],
+        expensive: bool = False,
+    ):
         super().__init__(n_inputs=1)
         self.program = program
+        self.expensive = expensive
 
     def process(self, inputs, time):
         batch = inputs[0]

@@ -136,8 +136,11 @@ class Table:
         tables.discard(self)
         if not tables:
             program = _compile_program(exprs, self)
+            expensive = any(_has_apply(e) for e in exprs.values())
             node = LogicalNode(
-                lambda: ops.RowwiseNode(program), [self._node], name="select"
+                lambda: ops.RowwiseNode(program, expensive=expensive),
+                [self._node],
+                name="select",
             )
             return Table(node, self._infer_schema(exprs), self._universe)
         return _multi_table_select(self, list(tables), exprs, self._infer_schema(exprs))
@@ -687,6 +690,13 @@ def _compile_program(
     return program
 
 
+def _has_apply(e) -> bool:
+    """Does the expression tree contain a python UDF (ApplyExpression family)?"""
+    if isinstance(e, expr_mod.ApplyExpression):
+        return True
+    return any(_has_apply(a) for a in e._args())
+
+
 def _compile_single(e: ColumnExpression, source: Table) -> Callable[[DeltaBatch], np.ndarray]:
     def single(batch: DeltaBatch) -> np.ndarray:
         def lookup(ref: ColumnReference) -> np.ndarray:
@@ -795,7 +805,12 @@ def _multi_table_select(
         ctx = EvalContext(lookup, len(batch))
         return {name: np.asarray(eval_expr(e, ctx)) for name, e in items}
 
-    node = LogicalNode(lambda: ops.RowwiseNode(program), [aligned._node], name="select_multi")
+    expensive = any(_has_apply(e) for e in exprs.values())
+    node = LogicalNode(
+        lambda: ops.RowwiseNode(program, expensive=expensive),
+        [aligned._node],
+        name="select_multi",
+    )
     return Table(node, schema, base._universe)
 
 

@@ -48,16 +48,36 @@ def _pad_to_capacity(n: int) -> int:
     return max(_MIN_CAPACITY, 1 << math.ceil(math.log2(max(n, 1))))
 
 
+def _key_bits_of(keys: Sequence[Any]) -> np.ndarray:
+    """Top 32 bits of each key (canonical tie-break, consistent with the
+    cross-shard merge's full-key ordering); non-int keys hash stably."""
+    out = np.empty(len(keys), dtype=np.uint32)
+    for i, k in enumerate(keys):
+        if isinstance(k, (int, np.integer)):
+            out[i] = (int(k) & 0xFFFFFFFFFFFFFFFF) >> 32
+        else:
+            from pathway_tpu.internals.keys import stable_hash_obj
+
+            out[i] = int(stable_hash_obj(k)) >> 32
+    return out
+
+
 @partial(jax.jit, static_argnames=("k", "metric"))
 def _search_kernel(
     vectors: jax.Array,      # [N, d] f32
     norms_sq: jax.Array,     # [N] f32 (precomputed row |v|^2)
     valid: jax.Array,        # [N] bool
+    key_bits: jax.Array,     # [N] uint32 (top 32 bits of each slot's key)
     queries: jax.Array,      # [Q, d] f32
     k: int,
     metric: str,
 ) -> tuple[jax.Array, jax.Array]:
-    """Return (scores [Q,k], slot_ids [Q,k]); invalid slots get -inf score."""
+    """Return (scores [Q,k], slot_ids [Q,k]); invalid slots get -inf score.
+
+    Ties break CANONICALLY by smaller key (via ``key_bits``), not by slot
+    order — so a sharded index cuts each shard's local top-k with exactly the
+    rule the cross-shard merge uses, and worker count cannot change which of
+    several equal-score documents survive the cut."""
     dots = jnp.einsum(
         "qd,nd->qn", queries, vectors, preferred_element_type=jnp.float32
     )
@@ -72,17 +92,45 @@ def _search_kernel(
     else:
         scores = dots
     scores = jnp.where(valid[None, :], scores, -jnp.inf)
-    # lax.top_k prefers the lower index on equal scores, giving the deterministic
-    # smaller-slot-id tie-break for free
-    top_scores, top_ids = jax.lax.top_k(scores, k)
+    if k == 0:  # static: resolved at trace time
+        q = queries.shape[0]
+        return (
+            jnp.zeros((q, 0), dtype=scores.dtype),
+            jnp.zeros((q, 0), dtype=jnp.int32),
+        )
+    # two passes, int32-safe (x64 stays off): pass 1 finds the k-th score per
+    # query; pass 2 takes everything strictly above it plus the smallest-key
+    # boundary ties — |above| < k always, so one top_k over the composite
+    # selects exactly the canonical set
+    top_scores0, _ = jax.lax.top_k(scores, k)
+    thr = top_scores0[:, -1:]
+    above = scores > thr
+    eq = (scores == thr) & valid[None, :]
+    inv_key30 = (jnp.uint32(0x3FFFFFFF) - (key_bits >> 2)).astype(jnp.int32)
+    comp = jnp.where(
+        above,
+        jnp.int32(0x7FFFFFFF),
+        jnp.where(eq, inv_key30[None, :], jnp.int32(-1)),
+    )
+    _c, top_ids = jax.lax.top_k(comp, k)
+    top_scores = jnp.take_along_axis(scores, top_ids, axis=1)
     return top_scores, top_ids
+
+
+def _key_order(key: Any):
+    if isinstance(key, (int, np.integer)):
+        return int(key)
+    from pathway_tpu.internals.keys import stable_hash_obj
+
+    return int(stable_hash_obj(key))
 
 
 def _decode_hits(
     scores_np: np.ndarray, ids_np: np.ndarray, slot_to_key: dict, k: int
 ) -> list[list[tuple[Any, float]]]:
-    """Turn [Q, kk] device results into per-query (key, score) lists, best first,
-    dropping -inf (invalid-slot) entries and slots freed since the last flush."""
+    """Turn [Q, kk] device results into per-query (key, score) lists ordered
+    canonically (score desc, key asc), dropping -inf (invalid-slot) entries and
+    slots freed since the last flush."""
     out: list[list[tuple[Any, float]]] = []
     for qi in range(ids_np.shape[0]):
         hits: list[tuple[Any, float]] = []
@@ -92,9 +140,8 @@ def _decode_hits(
             key = slot_to_key.get(int(ids_np[qi, j]))
             if key is not None:
                 hits.append((key, float(scores_np[qi, j])))
-            if len(hits) == k:
-                break
-        out.append(hits)
+        hits.sort(key=lambda kv: (-kv[1], _key_order(kv[0])))
+        out.append(hits[:k])
     return out
 
 
@@ -131,6 +178,8 @@ class BruteForceKnnIndex:
         self._vectors = jnp.zeros((capacity, dimension), dtype=dtype)
         self._norms_sq = jnp.zeros((capacity,), dtype=jnp.float32)
         self._valid = jnp.zeros((capacity,), dtype=bool)
+        # canonical tie-break bits per slot (top 32 bits of the key)
+        self._key_bits = jnp.zeros((capacity,), dtype=jnp.uint32)
         # host-side bookkeeping (not in the hot path)
         self._key_to_slot: dict[Any, int] = {}
         self._slot_to_key: dict[int, Any] = {}
@@ -150,6 +199,7 @@ class BruteForceKnnIndex:
         d["_vectors"] = np.asarray(self._vectors)
         d["_norms_sq"] = np.asarray(self._norms_sq)
         d["_valid"] = np.asarray(self._valid)
+        d["_key_bits"] = np.asarray(self._key_bits)
         return d
 
     def __setstate__(self, d):
@@ -157,6 +207,7 @@ class BruteForceKnnIndex:
         self._vectors = jnp.asarray(d["_vectors"])
         self._norms_sq = jnp.asarray(d["_norms_sq"])
         self._valid = jnp.asarray(d["_valid"])
+        self._key_bits = jnp.asarray(d["_key_bits"])
 
     # -- capacity ------------------------------------------------------------
     @property
@@ -174,6 +225,7 @@ class BruteForceKnnIndex:
         )
         self._norms_sq = jnp.concatenate([self._norms_sq, jnp.zeros((old,), jnp.float32)])
         self._valid = jnp.concatenate([self._valid, jnp.zeros((old,), bool)])
+        self._key_bits = jnp.concatenate([self._key_bits, jnp.zeros((old,), jnp.uint32)])
         self._free.extend(range(new - 1, old - 1, -1))
 
     # -- mutation ------------------------------------------------------------
@@ -240,7 +292,9 @@ class BruteForceKnnIndex:
             keep = sorted(last.values())
             vectors = vectors[jnp.asarray(keep)]
             slots = slots[keep]
-        self._pending_device.append((jnp.asarray(slots), vectors))
+        self._pending_device.append(
+            (jnp.asarray(slots), vectors, jnp.asarray(_key_bits_of(list(keys))))
+        )
 
     def remove(self, key: Any) -> None:
         slot = self._key_to_slot.pop(key, None)
@@ -280,8 +334,23 @@ class BruteForceKnnIndex:
                 keep = sorted(last.values())
                 slot_arr = slot_arr[keep]
                 self._pending_rows = [self._pending_rows[i] for i in keep]
-            slots = jnp.asarray(slot_arr)
             stacked = np.stack(self._pending_rows).astype(np.float32)
+            # pad to a power-of-two bucket so jit sees a small closed set of
+            # scatter shapes (sharded runs hands each worker a different shard
+            # size — unpadded, every size would compile its own kernel);
+            # padding repeats the last (slot, row) pair: duplicate writes of an
+            # identical value are harmless
+            from pathway_tpu.ops.microbatch import bucket_size
+
+            bits = _key_bits_of([self._slot_to_key[int(sl)] for sl in slot_arr])
+            m = len(slot_arr)
+            bucket = bucket_size(m, min_bucket=32)
+            if bucket > m:
+                pad = bucket - m
+                slot_arr = np.concatenate([slot_arr, np.repeat(slot_arr[-1:], pad)])
+                stacked = np.concatenate([stacked, np.repeat(stacked[-1:], pad, axis=0)])
+                bits = np.concatenate([bits, np.repeat(bits[-1:], pad)])
+            slots = jnp.asarray(slot_arr)
             self._vectors = _update_slots(
                 self._vectors, slots, jnp.asarray(stacked, dtype=self.dtype)
             )
@@ -289,11 +358,12 @@ class BruteForceKnnIndex:
                 jnp.asarray(np.sum(stacked * stacked, axis=-1))
             )
             self._valid = _set_valid(self._valid, slots, jnp.ones(len(slots), bool))
+            self._key_bits = self._key_bits.at[slots].set(jnp.asarray(bits))
             self._pending_slots, self._pending_rows = [], []
 
     def _flush_device(self) -> None:
         if self._pending_device:
-            for slots, dev in self._pending_device:
+            for slots, dev, bits in self._pending_device:
                 dev32 = dev.astype(jnp.float32)
                 self._vectors = _update_slots(
                     self._vectors, slots, dev.astype(self.dtype)
@@ -304,6 +374,7 @@ class BruteForceKnnIndex:
                 self._valid = _set_valid(
                     self._valid, slots, jnp.ones(len(dev32), bool)
                 )
+                self._key_bits = self._key_bits.at[slots].set(bits)
             self._pending_device = []
 
     # -- search --------------------------------------------------------------
@@ -325,7 +396,7 @@ class BruteForceKnnIndex:
             raise ValueError(f"query dim {q.shape[-1]} != {self.dimension}")
         kk = min(k, self.capacity)
         scores, slot_ids = _search_kernel(
-            self._vectors, self._norms_sq, self._valid, q,
+            self._vectors, self._norms_sq, self._valid, self._key_bits, q,
             k=kk, metric=self.metric.value,
         )
         return _decode_hits(np.asarray(scores), np.asarray(slot_ids), self._slot_to_key, k)
@@ -353,7 +424,8 @@ def sharded_search(
     k_final = min(k, n_shards * k_local)
 
     def local(vecs, nsq, val, q):
-        s, ids = _search_kernel(vecs, nsq, val, q, k=k_local, metric=metric)
+        zero_bits = jnp.zeros(vecs.shape[0], dtype=jnp.uint32)
+        s, ids = _search_kernel(vecs, nsq, val, zero_bits, q, k=k_local, metric=metric)
         shard_idx = jax.lax.axis_index(axis)
         gids = ids + shard_idx * shard_n
         # gather all shards' candidates: [n_shards*k_local] per query

@@ -36,7 +36,7 @@ from typing import Any
 import numpy as np
 
 from pathway_tpu.engine.blocks import DeltaBatch
-from pathway_tpu.engine.graph import END_OF_STREAM, SOLO, Node
+from pathway_tpu.engine.graph import BROADCAST, END_OF_STREAM, SOLO, Node
 from pathway_tpu.internals.config import get_pathway_config
 from pathway_tpu.internals.logical import BuildContext, LogicalNode
 from pathway_tpu.parallel.mesh import shard_of_keys
@@ -384,6 +384,9 @@ class ClusterRuntime:
                     consumer.accept(port, batch)
                 elif key_fn == SOLO:
                     self._deliver(0, ci, port, batch)
+                elif key_fn == BROADCAST:
+                    for w_idx in range(self.n_workers):
+                        self._deliver(w_idx, ci, port, batch)
                 else:
                     shards = shard_of_keys(
                         np.asarray(key_fn(batch), dtype=np.uint64), self.n_workers

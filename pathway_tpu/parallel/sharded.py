@@ -31,7 +31,7 @@ from typing import Any
 import numpy as np
 
 from pathway_tpu.engine.blocks import DeltaBatch
-from pathway_tpu.engine.graph import END_OF_STREAM, SOLO, EngineGraph, Node
+from pathway_tpu.engine.graph import BROADCAST, END_OF_STREAM, SOLO, EngineGraph, Node
 from pathway_tpu.internals.logical import BuildContext, LogicalNode
 from pathway_tpu.parallel.mesh import shard_of_keys
 
@@ -110,6 +110,12 @@ class ShardedRuntime:
                     dest = target.graph.nodes[ci]
                     with target.lock:
                         dest.accept(port, batch)
+                    routed = True
+                elif key_fn == BROADCAST:
+                    for target in self.workers:
+                        dest = target.graph.nodes[ci]
+                        with target.lock:
+                            dest.accept(port, batch)
                     routed = True
                 else:
                     if self.n_workers == 1:

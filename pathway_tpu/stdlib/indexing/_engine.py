@@ -50,6 +50,9 @@ class IndexBackend:
 class VectorBackend(IndexBackend):
     """Dense KNN over the HBM-resident brute-force index (ops/knn.py)."""
 
+    #: KNN scores are shard-independent: per-shard top-k partials merge exactly
+    shardable = True
+
     def __init__(self, dimension: int, metric: str = "cos", reserved_space: int = 1024):
         from pathway_tpu.ops.knn import BruteForceKnnIndex
 
@@ -95,6 +98,10 @@ class BM25Backend(IndexBackend):
 
     K1 = 1.2
     B = 0.75
+
+    #: BM25 idf depends on GLOBAL corpus statistics; per-shard scores would
+    #: change results, so this backend stays on one worker
+    shardable = False
 
     def __init__(self):
         self.docs: dict[int, dict[str, int]] = {}
@@ -162,27 +169,42 @@ class BM25Backend(IndexBackend):
 class ExternalIndexNode(Node):
     """input0 = docs (item, metadata); input1 = queries (item, k, filter).
 
-    Emits one reply row per query: ``_pw_index_reply`` = tuple of (doc_key, score),
-    keyed by the query's own key (universe of replies == universe of queries).
+    With a shardable backend (KNN), docs shard by key across workers and
+    queries BROADCAST to every shard: each instance answers over its local
+    shard and emits a PARTIAL reply row (killing the r2 worker-0 serialization
+    of the FLOP-heavy index — reference ``operators/external_index.rs:81`` runs
+    on one worker; this fans out). ``MergeIndexRepliesNode`` downstream merges
+    partials into the final per-query reply. Non-shardable backends (BM25:
+    global idf) keep the SOLO placement; their single partial passes through
+    the same merge.
     """
 
     name = "external_index"
 
     # _filter_cache (compiled callables) is rebuilt lazily, not persisted
-    snapshot_attrs = ("backend", "_live_queries", "_emitted")
+    snapshot_attrs = ("backend", "_live_queries", "_emitted", "_tok")
 
     def exchange_key(self, port):
-        from pathway_tpu.engine.graph import SOLO
+        from pathway_tpu.engine.graph import BROADCAST, SOLO
 
-        return SOLO  # global-watermark / ordered state: serial on worker 0
+        if not getattr(self.backend, "shardable", False):
+            return SOLO
+        if port == 0:
+            return lambda batch: batch.keys  # docs shard by key
+        return BROADCAST  # queries fan out to every doc shard
 
     def __init__(self, backend_factory: Callable[[], IndexBackend], as_of_now: bool):
         super().__init__(n_inputs=2)
         self.backend = backend_factory()
         self.as_of_now = as_of_now
         self._live_queries: dict[int, tuple[Any, int, str | None]] = {}
-        self._emitted: dict[int, tuple] = {}  # query key -> reply tuple emitted
+        self._emitted: dict[int, tuple] = {}  # query key -> partial tuple emitted
         self._filter_cache: dict[str | None, Callable] = {}
+        # identifies THIS shard's partials in the merge state (stable within a
+        # run; snapshotted so operator persistence keeps partials addressable)
+        import os as _os
+
+        self._tok = int.from_bytes(_os.urandom(8), "little")
 
     def _filter(self, expr):
         if expr not in self._filter_cache:
@@ -240,10 +262,10 @@ class ExternalIndexNode(Node):
         out_diffs: list[int] = []
         out_rows: list[tuple] = []
 
-        def emit(k, reply, diff):
+        def emit(k, reply, query_k, diff):
             out_keys.append(k)
             out_diffs.append(diff)
-            out_rows.append((reply,))
+            out_rows.append((reply, query_k, self._tok))
 
         new_queries: list[int] = []
         if queries is not None:
@@ -253,7 +275,7 @@ class ExternalIndexNode(Node):
                     self._live_queries.pop(k, None)
                     old = self._emitted.pop(k, None)
                     if old is not None:
-                        emit(k, old, -1)
+                        emit(k, old[0], old[1], -1)
             for i in range(len(queries)):
                 if queries.diffs[i] > 0:
                     k = int(queries.keys[i])
@@ -275,17 +297,97 @@ class ExternalIndexNode(Node):
         if to_answer:
             replies = self._answer(to_answer)
             for k, reply in zip(to_answer, replies):
+                query_k = self._live_queries[k][1]
                 old = self._emitted.get(k)
-                if old == reply:
+                if old is not None and old[0] == reply:
                     continue
                 if old is not None:
-                    emit(k, old, -1)
-                emit(k, reply, +1)
-                self._emitted[k] = reply
+                    emit(k, old[0], old[1], -1)
+                emit(k, reply, query_k, +1)
+                self._emitted[k] = (reply, query_k)
         if self.as_of_now:
             # answered queries need no further tracking (they are never revised)
             for k in to_answer:
                 self._live_queries.pop(k, None)
+        if not out_keys:
+            return []
+        return [
+            DeltaBatch.from_rows(
+                out_keys, out_rows, ["__part", "__k", "__tok"], time, diffs=out_diffs
+            )
+        ]
+
+
+class MergeIndexRepliesNode(Node):
+    """Merges per-shard partial replies into each query's final top-k.
+
+    Keyed (and shard-exchanged) by the QUERY key, so the merge itself scales
+    across workers too — no SOLO stage anywhere in the index path. Partials
+    accumulate per (query, shard-token); at the frontier every touched query
+    re-merges: sort the union by (score desc, doc-key asc), cut to the query's
+    k, and emit the delta against the previously-emitted reply.
+    """
+
+    name = "index_merge"
+
+    snapshot_attrs = ("state",)
+
+    def __init__(self):
+        super().__init__(n_inputs=1)
+        # qk -> {"parts": {tok: (partial, k)}, "emitted": tuple | None}
+        self.state: dict[int, dict] = {}
+        self._touched: set[int] = set()
+
+    def process(self, inputs, time):
+        batch = inputs[0]
+        if batch is None or not len(batch):
+            return []
+        parts = batch.data["__part"]
+        ks = batch.data["__k"]
+        toks = batch.data["__tok"]
+        for i in range(len(batch)):
+            qk = int(batch.keys[i])
+            tok = int(toks[i])
+            st = self.state.setdefault(qk, {"parts": {}, "emitted": None})
+            if batch.diffs[i] > 0:
+                st["parts"][tok] = (parts[i], int(ks[i]))
+            else:
+                st["parts"].pop(tok, None)
+            self._touched.add(qk)
+        return []
+
+    def on_frontier(self, time):
+        if not self._touched:
+            return []
+        out_keys: list[int] = []
+        out_diffs: list[int] = []
+        out_rows: list[tuple] = []
+        for qk in sorted(self._touched):
+            st = self.state.get(qk)
+            if st is None:
+                continue
+            if st["parts"]:
+                k = max(kk for (_p, kk) in st["parts"].values())
+                pairs = [p for (part, _kk) in st["parts"].values() for p in part]
+                pairs.sort(key=lambda ds: (-float(ds[1]), int(ds[0])))
+                merged: tuple | None = tuple(pairs[:k])
+            else:
+                merged = None  # every shard retracted: the query is gone
+            old = st["emitted"]
+            if merged == old:
+                continue
+            if old is not None:
+                out_keys.append(qk)
+                out_diffs.append(-1)
+                out_rows.append((old,))
+            if merged is not None:
+                out_keys.append(qk)
+                out_diffs.append(1)
+                out_rows.append((merged,))
+                st["emitted"] = merged
+            else:
+                del self.state[qk]
+        self._touched = set()
         if not out_keys:
             return []
         return [

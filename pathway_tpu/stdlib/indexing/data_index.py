@@ -22,7 +22,7 @@ from pathway_tpu.internals import schema as schema_mod
 from pathway_tpu.internals.expression import ColumnExpression, ColumnReference
 from pathway_tpu.internals.logical import LogicalNode
 from pathway_tpu.internals.table import Table
-from pathway_tpu.stdlib.indexing._engine import ExternalIndexNode, IndexBackend
+from pathway_tpu.stdlib.indexing._engine import ExternalIndexNode, IndexBackend, MergeIndexRepliesNode
 
 _SCORE = "_pw_index_reply_score"
 _INDEX_REPLY = "_pw_index_reply"
@@ -74,8 +74,11 @@ class InnerIndex:
             [docs._node, queries._node],
             name="external_index",
         )
+        merge = LogicalNode(
+            lambda: MergeIndexRepliesNode(), [node], name="index_merge"
+        )
         schema = schema_mod.schema_from_dtypes({_INDEX_REPLY: dt.ANY})
-        return Table(node, schema, qtable._universe.subset())
+        return Table(merge, schema, qtable._universe.subset())
 
     def query(self, query_column, *, number_of_matches=3, metadata_filter=None):
         return self._raw_reply(query_column, number_of_matches, metadata_filter, False)

@@ -147,3 +147,36 @@ def test_streaming_retractions_identical():
 
     r1, r4 = both(build)
     assert r1 == r4
+
+
+def test_sharded_knn_index_identical():
+    """The external KNN index now shards docs across workers (queries
+    broadcast, partials merge per query) — results must still be
+    byte-identical to single-worker (VERDICT r2 #5)."""
+    import numpy as np
+
+    from pathway_tpu.stdlib.indexing import BruteForceKnnFactory
+
+    rng = np.random.default_rng(7)
+    vecs = rng.normal(size=(64, 8)).astype(np.float32)
+    vecs[20:40] = vecs[20]  # 20 identical rows: score ties at the k boundary
+    qs = rng.normal(size=(12, 8)).astype(np.float32)
+    qs[0] = vecs[20]  # query hitting the tied block head-on
+
+    def build():
+        docs = pw.debug.table_from_rows(
+            pw.schema_from_types(emb=np.ndarray), [(v,) for v in vecs]
+        )
+        queries = pw.debug.table_from_rows(
+            pw.schema_from_types(emb=np.ndarray), [(q,) for q in qs]
+        )
+        factory = BruteForceKnnFactory(dimensions=8, reserved_space=128)
+        index = factory.build_index(docs.emb, docs)
+        # the raw reply table: one row per query, tuple of (doc_key, score)
+        return index.inner_index.query(queries.emb, number_of_matches=5)
+
+    r1, r4 = both(build)
+    assert r1 == r4
+    assert len(r1) == 12
+    # every reply carries exactly 5 matches
+    assert all(len(row[0]) == 5 for row in r1.values())
