@@ -89,6 +89,7 @@ from pathway_tpu.stdlib import temporal, indexing, ml, graphs, statistical, stat
 from pathway_tpu.stdlib import utils as utils
 from pathway_tpu.stdlib.utils.async_transformer import AsyncTransformer
 from pathway_tpu.internals.iterate import iterate, iterate_universe
+from pathway_tpu.internals.yaml_loader import load_yaml
 
 # commonly used temporal entry points at top level (parity with reference) ---
 from pathway_tpu.internals.errors import ERROR as _ERROR
@@ -174,6 +175,7 @@ __all__ = [
     "stateful",
     "utils",
     "AsyncTransformer",
+    "load_yaml",
     "temporal",
     "indexing",
     "universes",

@@ -187,6 +187,7 @@ class BruteForceKnnIndex:
         # staged updates, flushed as one batched scatter before the next search
         self._pending_slots: list[int] = []
         self._pending_rows: list[np.ndarray] = []
+        self._pending_bits: list[int] = []
         self._pending_invalidate: list[int] = []
         # device-resident staged blocks: (slots i32 array, [m, d] jax array)
         self._pending_device: list[tuple[Any, Any]] = []
@@ -241,6 +242,7 @@ class BruteForceKnnIndex:
             self._slot_to_key[slot] = key
         self._pending_slots.append(slot)
         self._pending_rows.append(vec)
+        self._pending_bits.append(int(_key_bits_of([key])[0]))
 
     def add(self, key: Any, vector: np.ndarray | Sequence[float]) -> None:
         vec = np.asarray(vector, dtype=np.float32)
@@ -334,6 +336,7 @@ class BruteForceKnnIndex:
                 keep = sorted(last.values())
                 slot_arr = slot_arr[keep]
                 self._pending_rows = [self._pending_rows[i] for i in keep]
+                self._pending_bits = [self._pending_bits[i] for i in keep]
             stacked = np.stack(self._pending_rows).astype(np.float32)
             # pad to a power-of-two bucket so jit sees a small closed set of
             # scatter shapes (sharded runs hands each worker a different shard
@@ -342,7 +345,9 @@ class BruteForceKnnIndex:
             # identical value are harmless
             from pathway_tpu.ops.microbatch import bucket_size
 
-            bits = _key_bits_of([self._slot_to_key[int(sl)] for sl in slot_arr])
+            # bits were captured at staging time: a key may have been removed
+            # since (its slot gets invalidated separately)
+            bits = np.asarray(self._pending_bits, dtype=np.uint32)
             m = len(slot_arr)
             bucket = bucket_size(m, min_bucket=32)
             if bucket > m:
@@ -359,7 +364,7 @@ class BruteForceKnnIndex:
             )
             self._valid = _set_valid(self._valid, slots, jnp.ones(len(slots), bool))
             self._key_bits = self._key_bits.at[slots].set(jnp.asarray(bits))
-            self._pending_slots, self._pending_rows = [], []
+            self._pending_slots, self._pending_rows, self._pending_bits = [], [], []
 
     def _flush_device(self) -> None:
         if self._pending_device:

@@ -395,3 +395,92 @@ class MergeIndexRepliesNode(Node):
                 out_keys, out_rows, ["_pw_index_reply"], time, diffs=out_diffs
             )
         ]
+
+
+class LshVectorBackend(IndexBackend):
+    """Approximate KNN via LSH bucket pruning (the ANN answer to the
+    reference's usearch/HNSW integrations, ``usearch_integration.rs:20``):
+    candidates come from the union of a query's band buckets
+    (``stdlib/ml/classifiers/_lsh.py`` bucketers), then score EXACTLY — so
+    accuracy degrades only by bucket recall, never by score error, and
+    per-shard candidate sets still merge exactly (scores are
+    shard-independent)."""
+
+    shardable = True
+
+    def __init__(
+        self,
+        dimension: int,
+        metric: str = "cos",
+        n_or: int = 10,
+        n_and: int = 8,
+        bucket_length: float = 1.0,
+        seed: int = 0,
+    ):
+        from pathway_tpu.stdlib.ml.classifiers._lsh import (
+            generate_cosine_lsh_bucketer,
+            generate_euclidean_lsh_bucketer,
+        )
+
+        self.metric = metric
+        if metric == "cos":
+            self.bucketer = generate_cosine_lsh_bucketer(
+                dimension, M=n_and, L=n_or, seed=seed
+            )
+        else:
+            self.bucketer = generate_euclidean_lsh_bucketer(
+                dimension, M=n_and, L=n_or, A=bucket_length, seed=seed
+            )
+        self.vectors: dict[int, np.ndarray] = {}
+        self.metadata: dict[int, Any] = {}
+        self.bands: dict[int, np.ndarray] = {}  # key -> its L band hashes
+        self.buckets: dict[int, set[int]] = {}  # band hash -> keys
+
+    def add(self, key, item, metadata):
+        vec = np.asarray(item, dtype=np.float32)
+        if key in self.vectors:
+            self.remove(key)
+        bands = self.bucketer(vec)[0]
+        self.vectors[key] = vec
+        self.metadata[key] = metadata
+        self.bands[key] = bands
+        for b in bands.tolist():
+            self.buckets.setdefault(int(b), set()).add(key)
+
+    def remove(self, key):
+        self.vectors.pop(key, None)
+        self.metadata.pop(key, None)
+        bands = self.bands.pop(key, None)
+        if bands is not None:
+            for b in bands.tolist():
+                bucket = self.buckets.get(int(b))
+                if bucket is not None:
+                    bucket.discard(key)
+                    if not bucket:
+                        del self.buckets[int(b)]
+
+    def _score(self, cand_mat: np.ndarray, q: np.ndarray) -> np.ndarray:
+        if self.metric == "cos":
+            qn = np.linalg.norm(q) or 1.0
+            dn = np.linalg.norm(cand_mat, axis=1)
+            dn[dn == 0] = 1.0
+            return (cand_mat @ q) / (dn * qn)
+        diff = cand_mat - q[None, :]
+        return -(diff * diff).sum(axis=1)
+
+    def search(self, items, ks, filters):
+        out = []
+        for q, k, flt in zip(items, ks, filters):
+            qv = np.asarray(q, dtype=np.float32)
+            cands: set[int] = set()
+            for b in self.bucketer(qv)[0].tolist():
+                cands |= self.buckets.get(int(b), set())
+            good = [c for c in sorted(cands) if flt(self.metadata.get(c))]
+            if not good:
+                out.append([])
+                continue
+            mat = np.stack([self.vectors[c] for c in good])
+            scores = self._score(mat, qv)
+            order = np.lexsort((np.asarray(good, dtype=np.uint64), -scores))[:k]
+            out.append([(good[i], float(scores[i])) for i in order])
+        return out

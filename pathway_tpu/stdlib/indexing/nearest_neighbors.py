@@ -64,8 +64,39 @@ class BruteForceKnn(InnerIndex):
         self.metric = metric_val
 
 
-class LshKnn(BruteForceKnn):
-    """Reference API parity; served by the exact HBM backend (see module note)."""
+class LshKnn(InnerIndex):
+    """Approximate KNN: LSH band buckets prune candidates, exact scoring ranks
+    them (reference ``LshKnn``; backend in ``_engine.LshVectorBackend``)."""
+
+    def __init__(
+        self,
+        data_column: ColumnReference,
+        dimensions: int,
+        *,
+        reserved_space: int = 1024,
+        metric: DistanceMetric | str = DistanceMetric.COS,
+        metadata_column: ColumnExpression | None = None,
+        embedder=None,
+        n_or: int = 10,
+        n_and: int = 8,
+        bucket_length: float = 1.0,
+    ):
+        from pathway_tpu.stdlib.indexing._engine import LshVectorBackend
+
+        metric_val = metric.value if isinstance(metric, DistanceMetric) else str(metric)
+        transform = _embedder_transform(embedder)
+        super().__init__(
+            data_column,
+            metadata_column=metadata_column,
+            backend_factory=lambda: LshVectorBackend(
+                dimension=dimensions,
+                metric=metric_val,
+                n_or=n_or,
+                n_and=n_and,
+                bucket_length=bucket_length,
+            ),
+            item_transform=transform,
+        )
 
 
 class UsearchKnn(BruteForceKnn):

@@ -1,0 +1,202 @@
+"""pw.sql (real parser), pw.load_yaml templates, LshKnn ANN backend
+(VERDICT r2 missing #9; reference: ``internals/sql.py`` via sqlglot,
+``internals/yaml_loader.py:74-214``, ``usearch_integration.rs:20``)."""
+
+import os
+
+import numpy as np
+import pytest
+
+import pathway_tpu as pw
+
+from utils import rows_of
+
+
+class TwoCol(pw.Schema):
+    a: int
+    b: int
+
+
+def _tab():
+    return pw.debug.table_from_rows(TwoCol, [(1, 10), (2, 20), (3, 30), (1, 5)])
+
+
+# ------------------------------------------------------------------------ sql
+
+
+def test_sql_select_where_arithmetic_alias():
+    r = pw.sql("SELECT a, b * 2 AS dbl FROM t WHERE b >= 10 AND a < 3", t=_tab())
+    assert sorted(rows_of(r).elements()) == [(1, 20), (2, 40)]
+
+
+def test_sql_group_by_having():
+    r = pw.sql(
+        "SELECT a, SUM(b) AS total, COUNT(*) AS n FROM t GROUP BY a HAVING total > 10",
+        t=_tab(),
+    )
+    assert sorted(rows_of(r).elements()) == [(1, 15, 2), (2, 20, 1), (3, 30, 1)]
+
+
+def test_sql_joins():
+    names = pw.debug.table_from_rows(
+        pw.schema_from_types(a=int, name=str), [(1, "x"), (2, "y"), (9, "z")]
+    )
+    r = pw.sql(
+        "SELECT t.a, names.name FROM t JOIN names ON t.a = names.a WHERE t.b > 5",
+        t=_tab(),
+        names=names,
+    )
+    assert sorted(rows_of(r).elements()) == [(1, "x"), (2, "y")]
+    lo = pw.sql(
+        "SELECT names.name, t.b FROM names LEFT JOIN t ON names.a = t.a",
+        t=_tab(),
+        names=names,
+    )
+    rows = sorted(rows_of(lo).elements(), key=str)
+    assert ("z", None) in rows  # unmatched left row pads
+
+
+def test_sql_global_aggregate():
+    r = pw.sql("SELECT COUNT(*) AS n, AVG(b) AS m, MAX(b) AS mx FROM t", t=_tab())
+    assert sorted(rows_of(r).elements()) == [(4, 16.25, 30)]
+
+
+def test_sql_cte_union_intersect():
+    u = pw.sql(
+        "WITH big AS (SELECT a FROM t WHERE b > 15) "
+        "SELECT a FROM big UNION SELECT a FROM t WHERE a = 1",
+        t=_tab(),
+    )
+    assert sorted(rows_of(u).elements()) == [(1,), (2,), (3,)]
+    ua = pw.sql("SELECT a FROM t UNION ALL SELECT a FROM t", t=_tab())
+    assert sum(rows_of(ua).values()) == 8
+    ix = pw.sql(
+        "SELECT a FROM t WHERE b > 15 INTERSECT SELECT a FROM t WHERE a >= 2",
+        t=_tab(),
+    )
+    assert sorted(rows_of(ix).elements()) == [(2,), (3,)]
+
+
+def test_sql_is_null_and_literals():
+    from typing import Optional
+
+    t = pw.debug.table_from_rows(
+        pw.schema_from_types(a=int, s=Optional[str]), [(1, "x"), (2, None)]
+    )
+    r = pw.sql("SELECT a FROM t WHERE s IS NULL", t=t)
+    assert sorted(rows_of(r).elements()) == [(2,)]
+    r2 = pw.sql("SELECT a FROM t WHERE s IS NOT NULL OR a = 2", t=t)
+    assert sorted(rows_of(r2).elements()) == [(1,), (2,)]
+
+
+def test_sql_rejects_garbage():
+    with pytest.raises(ValueError):
+        pw.sql("DELETE FROM t", t=_tab())
+    with pytest.raises(ValueError):
+        pw.sql("SELECT a FROM missing", t=_tab())
+
+
+# ----------------------------------------------------------------------- yaml
+
+
+def test_yaml_tags_variables_sharing():
+    spec = """
+$dim: 16
+$factory: !pw.stdlib.indexing.BruteForceKnnFactory
+  dimensions: $dim
+  reserved_space: 256
+retriever: $factory
+again: $factory
+plain: {a: 1}
+"""
+    out = pw.load_yaml(spec)
+    from pathway_tpu.stdlib.indexing import BruteForceKnnFactory
+
+    assert isinstance(out["retriever"], BruteForceKnnFactory)
+    assert out["retriever"].dimensions == 16
+    assert out["retriever"] is out["again"]  # one definition -> one instance
+    assert out["plain"] == {"a": 1}
+
+
+def test_yaml_env_fallback_and_errors(monkeypatch):
+    monkeypatch.setenv("PW_TEST_SETTING", "7")
+    assert pw.load_yaml("x: $PW_TEST_SETTING") == {"x": 7}
+    with pytest.raises(KeyError):
+        pw.load_yaml("x: $undefined_lowercase")
+    with pytest.raises(ValueError, match="circular"):
+        pw.load_yaml("$a: $b\n$b: $a\nx: $a")
+
+
+def test_yaml_empty_tag_calls_constructor():
+    out = pw.load_yaml("f: !pw.stdlib.indexing.TantivyBM25Factory\n")
+    from pathway_tpu.stdlib.indexing import TantivyBM25Factory
+
+    assert isinstance(out["f"], TantivyBM25Factory)
+
+
+# ------------------------------------------------------------------------ ann
+
+
+def test_lsh_knn_ann_recall_on_clusters():
+    rng = np.random.default_rng(3)
+    vecs = np.vstack(
+        [rng.normal(0, 0.1, (30, 16)) + 1, rng.normal(0, 0.1, (30, 16)) - 1]
+    ).astype(np.float32)
+    docs = pw.debug.table_from_rows(
+        pw.schema_from_types(emb=np.ndarray), [(v,) for v in vecs]
+    )
+    index = pw.stdlib.indexing.LshKnnFactory(dimensions=16).build_index(
+        docs.emb, docs
+    )
+    qs = pw.debug.table_from_rows(
+        pw.schema_from_types(emb=np.ndarray),
+        [(np.full(16, 0.95, dtype=np.float32),)],
+    )
+    r = index.inner_index.query(qs.emb, number_of_matches=5)
+    hits = list(rows_of(r).elements())[0][0]
+    assert len(hits) == 5 and all(s > 0.9 for (_k, s) in hits)
+
+
+def test_lsh_backend_remove_and_update():
+    from pathway_tpu.stdlib.indexing._engine import LshVectorBackend
+
+    b = LshVectorBackend(dimension=4)
+    b.add(1, np.ones(4, dtype=np.float32), {})
+    b.add(2, np.full(4, 0.9, dtype=np.float32), {})
+    always = lambda md: True  # noqa: E731
+    hits = b.search([np.ones(4, dtype=np.float32)], [2], [always])[0]
+    assert [k for (k, _s) in hits] == [1, 2]
+    b.remove(1)
+    hits = b.search([np.ones(4, dtype=np.float32)], [2], [always])[0]
+    assert [k for (k, _s) in hits] == [2]
+    # upsert moves the key's buckets
+    b.add(2, -np.ones(4, dtype=np.float32), {})
+    hits = b.search([-np.ones(4, dtype=np.float32)], [1], [always])[0]
+    assert [k for (k, _s) in hits] == [2]
+
+
+def test_sql_join_same_named_columns_do_not_shadow():
+    a = pw.debug.table_from_rows(pw.schema_from_types(k=int, x=int), [(1, 100), (2, 200)])
+    b = pw.debug.table_from_rows(pw.schema_from_types(k=int, x=int), [(1, -1)])
+    r = pw.sql("SELECT a.x FROM a LEFT JOIN b ON a.k = b.k", a=a, b=b)
+    assert sorted(rows_of(r).elements(), key=str) == [(100,), (200,)]
+    r2 = pw.sql("SELECT a.x AS ax, b.x AS bx FROM a LEFT JOIN b ON a.k = b.k", a=a, b=b)
+    assert sorted(rows_of(r2).elements(), key=str) == [(100, -1), (200, None)]
+
+
+def test_sql_having_with_inline_aggregate():
+    r = pw.sql(
+        "SELECT a, SUM(b) AS s FROM t GROUP BY a HAVING COUNT(*) > 1", t=_tab()
+    )
+    assert sorted(rows_of(r).elements()) == [(1, 15)]
+    # hidden aggregate columns must not leak into the output schema
+    assert set(r.column_names()) == {"a", "s"}
+
+
+def test_knn_add_remove_before_flush():
+    from pathway_tpu.ops.knn import BruteForceKnnIndex
+
+    ix = BruteForceKnnIndex(dimension=4)
+    ix.add("k1", np.ones(4, dtype=np.float32))
+    ix.remove("k1")  # same flush window: staged bits must not need the key
+    assert ix.search(np.ones((1, 4), dtype=np.float32), 2) == [[]]
