@@ -88,26 +88,56 @@ def _splitmix64_int(x: int) -> int:
     return x ^ (x >> 31)
 
 
+_NONE_SEED = 0xA5C9
+
+
+def _pwhash_bytes(b: bytes, tag: int) -> int:
+    """splitmix64 over zero-padded little-endian 8-byte chunks, seeded with a
+    type tag and the length — the pure-Python mirror of
+    ``native/pwhash.c::pwhash_bytes`` (the two MUST stay bit-identical)."""
+    n = len(b)
+    h = _splitmix64_int(tag ^ n)
+    full = n - (n % 8)
+    for i in range(0, full, 8):
+        h = _splitmix64_int(h ^ int.from_bytes(b[i : i + 8], "little"))
+    if full < n:
+        h = _splitmix64_int(h ^ int.from_bytes(b[full:], "little"))
+    return h
+
+
 def stable_hash_obj(v: Any) -> np.uint64:
     # Scalars that can also live in typed numpy columns MUST hash identically to
     # hash_column's vectorized paths — join/group keys may see the same value in
     # either storage (e.g. int64 column on one side, object column on the other).
-    if isinstance(v, (bool, np.bool_, int, np.integer)):
-        return np.uint64(_splitmix64_int(int(v) & _U64_MASK))
-    if isinstance(v, (float, np.floating)):
-        f = np.float64(v) + 0.0  # normalize -0.0
-        return np.uint64(_splitmix64_int(int(f.view(np.uint64))))
+    if v is None:
+        return np.uint64(_splitmix64_int(_NONE_SEED))
+    # datetime64/timedelta64 must precede the integer branch: timedelta64
+    # subclasses np.signedinteger, and int() of a non-ns timedelta64 raises
     if isinstance(v, np.datetime64):
         ns = int(v.astype("datetime64[ns]").astype(np.int64))
         return np.uint64(_splitmix64_int(ns & _U64_MASK))
     if isinstance(v, np.timedelta64):
         ns = int(v.astype("timedelta64[ns]").astype(np.int64))
         return np.uint64(_splitmix64_int(ns & _U64_MASK))
+    if isinstance(v, (bool, np.bool_, int, np.integer)):
+        return np.uint64(_splitmix64_int(int(v) & _U64_MASK))
+    if isinstance(v, (float, np.floating)):
+        f = np.float64(v) + 0.0  # normalize -0.0
+        return np.uint64(_splitmix64_int(int(f.view(np.uint64))))
+    if isinstance(v, str):
+        return np.uint64(_pwhash_bytes(v.encode("utf-8"), 0x04))
+    if isinstance(v, bytes):
+        return np.uint64(_pwhash_bytes(v, 0x05))
     digest = hashlib.blake2b(_canonical_bytes(v), digest_size=8).digest()
     return np.uint64(int.from_bytes(digest, "little"))
 
 
 _hash_obj_ufunc = np.frompyfunc(stable_hash_obj, 1, 1)
+
+# C kernel for the object-column loop (lazily built; None -> pure Python)
+from pathway_tpu.native import try_load as _try_load_native  # noqa: E402
+
+_pwhash_native = _try_load_native("pwhash")
 
 _INT_TYPES = (bool, np.bool_, int, np.int64, np.int32, np.intp)
 _FLOAT_TYPES = (float, np.float64, np.float32)
@@ -140,6 +170,8 @@ def hash_column(col: np.ndarray) -> np.ndarray:
                 return splitmix64(c.view(np.uint64))
         except (TypeError, ValueError, OverflowError):
             pass
+    if _pwhash_native is not None:
+        return _pwhash_native.hash_obj_array(col, stable_hash_obj)
     return _hash_obj_ufunc(col).astype(np.uint64)
 
 

@@ -350,6 +350,16 @@ def _has_agg(node: dict) -> bool:
     return any(_has_agg(v) for v in node.values() if isinstance(v, dict))
 
 
+def _unique_name(base: str, taken: dict) -> str:
+    """SQL result columns never silently collide: later duplicates get _1, _2…"""
+    if base not in taken:
+        return base
+    n = 1
+    while f"{base}_{n}" in taken:
+        n += 1
+    return f"{base}_{n}"
+
+
 def _default_name(node: dict, i: int) -> str:
     if node["k"] == "col":
         return node["name"]
@@ -446,7 +456,9 @@ def _translate_select(node: dict, env: dict[str, Table]) -> Table:
         for i, (alias, e) in enumerate(items):
             if e["k"] == "star":
                 raise ValueError("pw.sql: SELECT * with GROUP BY is not supported")
-            out[alias or _default_name(e, i)] = _build_expr(e, scope, in_agg=True)
+            out[_unique_name(alias or _default_name(e, i), out)] = _build_expr(
+                e, scope, in_agg=True
+            )
         having = node["having"]
         hidden: list[dict] = []
         if having is not None:
@@ -465,7 +477,9 @@ def _translate_select(node: dict, env: dict[str, Table]) -> Table:
     if any(_has_agg(e) for (_a, e) in items if e["k"] != "star"):
         out = {}
         for i, (alias, e) in enumerate(items):
-            out[alias or _default_name(e, i)] = _build_expr(e, scope, in_agg=True)
+            out[_unique_name(alias or _default_name(e, i), out)] = _build_expr(
+                e, scope, in_agg=True
+            )
         return current.reduce(**out)
 
     if len(items) == 1 and items[0][1]["k"] == "star":
@@ -474,16 +488,16 @@ def _translate_select(node: dict, env: dict[str, Table]) -> Table:
         out = {}
         for tn, frame in frames.items():
             for cn, mat in frame.items():
-                out.setdefault(cn, current[mat])
+                out[_unique_name(cn, out)] = current[mat]
         return current.select(**out)
     out = {}
     for i, (alias, e) in enumerate(items):
         if e["k"] == "star":
             for tn, frame in frames.items():
                 for cn, mat in frame.items():
-                    out.setdefault(cn, current[mat])
+                    out[_unique_name(cn, out)] = current[mat]
             continue
-        out[alias or _default_name(e, i)] = _build_expr(e, scope)
+        out[_unique_name(alias or _default_name(e, i), out)] = _build_expr(e, scope)
     return current.select(**out)
 
 

@@ -48,18 +48,18 @@ def _pad_to_capacity(n: int) -> int:
     return max(_MIN_CAPACITY, 1 << math.ceil(math.log2(max(n, 1))))
 
 
-def _key_bits_of(keys: Sequence[Any]) -> np.ndarray:
-    """Top 32 bits of each key (canonical tie-break, consistent with the
+def _key_bits_one(k: Any) -> int:
+    """Top 32 bits of a key (canonical tie-break, consistent with the
     cross-shard merge's full-key ordering); non-int keys hash stably."""
-    out = np.empty(len(keys), dtype=np.uint32)
-    for i, k in enumerate(keys):
-        if isinstance(k, (int, np.integer)):
-            out[i] = (int(k) & 0xFFFFFFFFFFFFFFFF) >> 32
-        else:
-            from pathway_tpu.internals.keys import stable_hash_obj
+    if isinstance(k, (int, np.integer)):
+        return (int(k) & 0xFFFFFFFFFFFFFFFF) >> 32
+    from pathway_tpu.internals.keys import stable_hash_obj
 
-            out[i] = int(stable_hash_obj(k)) >> 32
-    return out
+    return int(stable_hash_obj(k)) >> 32
+
+
+def _key_bits_of(keys: Sequence[Any]) -> np.ndarray:
+    return np.fromiter((_key_bits_one(k) for k in keys), dtype=np.uint32, count=len(keys))
 
 
 @partial(jax.jit, static_argnames=("k", "metric"))
@@ -242,7 +242,7 @@ class BruteForceKnnIndex:
             self._slot_to_key[slot] = key
         self._pending_slots.append(slot)
         self._pending_rows.append(vec)
-        self._pending_bits.append(int(_key_bits_of([key])[0]))
+        self._pending_bits.append(_key_bits_one(key))
 
     def add(self, key: Any, vector: np.ndarray | Sequence[float]) -> None:
         vec = np.asarray(vector, dtype=np.float32)
@@ -287,6 +287,7 @@ class BruteForceKnnIndex:
                 self._key_to_slot[key] = slot
                 self._slot_to_key[slot] = key
             slots[i] = slot
+        bits = _key_bits_of(list(keys))
         if len(np.unique(slots)) != len(slots):
             # duplicate keys in one call: scatter winners are undefined, keep
             # the last staging per slot (device-side gather)
@@ -294,9 +295,8 @@ class BruteForceKnnIndex:
             keep = sorted(last.values())
             vectors = vectors[jnp.asarray(keep)]
             slots = slots[keep]
-        self._pending_device.append(
-            (jnp.asarray(slots), vectors, jnp.asarray(_key_bits_of(list(keys))))
-        )
+            bits = bits[keep]
+        self._pending_device.append((jnp.asarray(slots), vectors, jnp.asarray(bits)))
 
     def remove(self, key: Any) -> None:
         slot = self._key_to_slot.pop(key, None)

@@ -423,14 +423,17 @@ class LshVectorBackend(IndexBackend):
         )
 
         self.metric = metric
-        if metric == "cos":
+        if metric in ("cos", "dot"):
+            # direction-sensitive metrics use hyperplane buckets
             self.bucketer = generate_cosine_lsh_bucketer(
                 dimension, M=n_and, L=n_or, seed=seed
             )
-        else:
+        elif metric in ("l2sq", "euclidean"):
             self.bucketer = generate_euclidean_lsh_bucketer(
                 dimension, M=n_and, L=n_or, A=bucket_length, seed=seed
             )
+        else:
+            raise ValueError(f"LshVectorBackend: unsupported metric {metric!r}")
         self.vectors: dict[int, np.ndarray] = {}
         self.metadata: dict[int, Any] = {}
         self.bands: dict[int, np.ndarray] = {}  # key -> its L band hashes
@@ -465,8 +468,12 @@ class LshVectorBackend(IndexBackend):
             dn = np.linalg.norm(cand_mat, axis=1)
             dn[dn == 0] = 1.0
             return (cand_mat @ q) / (dn * qn)
-        diff = cand_mat - q[None, :]
-        return -(diff * diff).sum(axis=1)
+        if self.metric == "dot":
+            return cand_mat @ q
+        if self.metric in ("l2sq", "euclidean"):
+            diff = cand_mat - q[None, :]
+            return -(diff * diff).sum(axis=1)
+        raise ValueError(f"LshVectorBackend: unsupported metric {self.metric!r}")
 
     def search(self, items, ks, filters):
         out = []

@@ -200,3 +200,37 @@ def test_knn_add_remove_before_flush():
     ix.add("k1", np.ones(4, dtype=np.float32))
     ix.remove("k1")  # same flush window: staged bits must not need the key
     assert ix.search(np.ones((1, 4), dtype=np.float32), 2) == [[]]
+
+
+def test_sql_duplicate_output_names_uniquified():
+    r = pw.sql("SELECT SUM(a) , SUM(b) FROM t", t=_tab())
+    assert set(r.column_names()) == {"sum", "sum_1"}
+    assert sorted(rows_of(r).elements()) == [(7, 65)]
+    a = pw.debug.table_from_rows(pw.schema_from_types(k=int, x=int), [(1, 100)])
+    b = pw.debug.table_from_rows(pw.schema_from_types(k=int, x=int), [(1, -1)])
+    star = pw.sql("SELECT * FROM a JOIN b ON a.k = b.k", a=a, b=b)
+    assert set(star.column_names()) == {"k", "x", "k_1", "x_1"}
+
+
+def test_lsh_dot_metric():
+    from pathway_tpu.stdlib.indexing._engine import LshVectorBackend
+
+    b = LshVectorBackend(dimension=4, metric="dot")
+    b.add(1, np.full(4, 2.0, dtype=np.float32), {})
+    b.add(2, np.full(4, 1.0, dtype=np.float32), {})
+    hits = b.search([np.ones(4, dtype=np.float32)], [2], [lambda md: True])[0]
+    assert [k for (k, _s) in hits] == [1, 2]  # larger dot wins
+    with pytest.raises(ValueError, match="unsupported metric"):
+        LshVectorBackend(dimension=4, metric="bogus")
+
+
+def test_knn_device_duplicate_keys_dedup_bits():
+    import jax.numpy as jnp
+
+    from pathway_tpu.ops.knn import BruteForceKnnIndex
+
+    ix = BruteForceKnnIndex(dimension=4)
+    vs = jnp.stack([jnp.full(4, 1.0), jnp.full(4, 2.0), jnp.full(4, 9.0)])
+    ix.add_batch_device(["k1", "k2", "k1"], vs)
+    hits = ix.search(np.full((1, 4), 9.0, dtype=np.float32), 2)[0]
+    assert [k for (k, _s) in hits] and len(ix) == 2
