@@ -90,13 +90,27 @@ def _splitmix64_int(x: int) -> int:
 
 _NONE_SEED = 0xA5C9
 
+# Deployment-stable salt for object-key hashing. pwhash64 is a fast NON-
+# CRYPTOGRAPHIC hash (like the reference engine's key hashing): with the
+# default salt an adversary who fully controls input keys can engineer
+# collisions. Deployments ingesting untrusted keys can set PATHWAY_HASH_SALT
+# to make the chain unpredictable; it must be identical on every process of a
+# cluster and across restarts of a persisted pipeline.
+import os as _os
+
+_HASH_SALT = (
+    _splitmix64_int(int(_os.environ["PATHWAY_HASH_SALT"]) & _U64_MASK)
+    if "PATHWAY_HASH_SALT" in _os.environ
+    else 0
+)
+
 
 def _pwhash_bytes(b: bytes, tag: int) -> int:
     """splitmix64 over zero-padded little-endian 8-byte chunks, seeded with a
     type tag and the length — the pure-Python mirror of
     ``native/pwhash.c::pwhash_bytes`` (the two MUST stay bit-identical)."""
     n = len(b)
-    h = _splitmix64_int(tag ^ n)
+    h = _splitmix64_int(tag ^ _HASH_SALT ^ n)
     full = n - (n % 8)
     for i in range(0, full, 8):
         h = _splitmix64_int(h ^ int.from_bytes(b[i : i + 8], "little"))
@@ -110,7 +124,9 @@ def stable_hash_obj(v: Any) -> np.uint64:
     # hash_column's vectorized paths — join/group keys may see the same value in
     # either storage (e.g. int64 column on one side, object column on the other).
     if v is None:
-        return np.uint64(_splitmix64_int(_NONE_SEED))
+        # double-mixed so the colliding integer pre-image is a pseudo-random
+        # 64-bit value, not the small literal 0xA5C9
+        return np.uint64(_splitmix64_int(_splitmix64_int(_NONE_SEED)))
     # datetime64/timedelta64 must precede the integer branch: timedelta64
     # subclasses np.signedinteger, and int() of a non-ns timedelta64 raises
     if isinstance(v, np.datetime64):
@@ -171,7 +187,7 @@ def hash_column(col: np.ndarray) -> np.ndarray:
         except (TypeError, ValueError, OverflowError):
             pass
     if _pwhash_native is not None:
-        return _pwhash_native.hash_obj_array(col, stable_hash_obj)
+        return _pwhash_native.hash_obj_array(col, stable_hash_obj, _HASH_SALT)
     return _hash_obj_ufunc(col).astype(np.uint64)
 
 

@@ -367,6 +367,13 @@ class Table:
         )
 
     def restrict(self, other: "Table", strict: bool = True) -> "Table":
+        # query_is_subset is reflexive over equal representatives, so the
+        # equality case is already covered
+        if strict and not solver().query_is_subset(other._universe, self._universe):
+            raise ValueError(
+                "restrict: the argument's universe is not a known subset of "
+                "this table's; use promise_universe_is_subset_of first"
+            )
         cols = self.column_names()
 
         def combine(key: int, rows: list[tuple | None]) -> tuple | None:

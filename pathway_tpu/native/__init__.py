@@ -21,6 +21,8 @@ def _load(name: str):
     if not os.path.exists(so_path) or os.path.getmtime(so_path) < src_mtime:
         # a recorded failure for this exact source skips the doomed compile on
         # every later process start (cleared by touching the source)
+        if os.environ.get(f"PATHWAY_NATIVE_{name.upper()}_FAILED") == str(src_mtime):
+            raise RuntimeError(f"native build of {name} previously failed")
         if os.path.exists(marker):
             with open(marker) as f:
                 if f.read().strip() == str(src_mtime):
@@ -38,8 +40,12 @@ def _load(name: str):
         try:
             subprocess.run(cmd, check=True, capture_output=True)
         except Exception:
-            with open(marker, "w") as f:
-                f.write(str(src_mtime))
+            try:
+                with open(marker, "w") as f:
+                    f.write(str(src_mtime))
+            except OSError:
+                pass  # read-only install: the env guard below still helps
+            os.environ[f"PATHWAY_NATIVE_{name.upper()}_FAILED"] = str(src_mtime)
             raise
         os.replace(tmp, so_path)  # atomic publish; racing winners are identical
     spec = importlib.util.spec_from_file_location(name, so_path)

@@ -27,8 +27,9 @@ static inline uint64_t splitmix64(uint64_t x) {
     return x ^ (x >> 31);
 }
 
-static uint64_t pwhash_bytes(const unsigned char *p, Py_ssize_t n, uint64_t tag) {
-    uint64_t h = splitmix64(tag ^ (uint64_t)n);
+static uint64_t pwhash_bytes(const unsigned char *p, Py_ssize_t n, uint64_t tag,
+                             uint64_t salt) {
+    uint64_t h = splitmix64(tag ^ salt ^ (uint64_t)n);
     Py_ssize_t i = 0;
     for (; i + 8 <= n; i += 8) {
         uint64_t chunk;
@@ -45,9 +46,9 @@ static uint64_t pwhash_bytes(const unsigned char *p, Py_ssize_t n, uint64_t tag)
 
 #define NONE_SEED 0xA5C9ULL
 
-static int hash_one(PyObject *v, PyObject *fallback, uint64_t *out) {
+static int hash_one(PyObject *v, PyObject *fallback, uint64_t salt, uint64_t *out) {
     if (v == Py_None) {
-        *out = splitmix64(NONE_SEED);
+        *out = splitmix64(splitmix64(NONE_SEED));
         return 0;
     }
     if (PyBool_Check(v)) {
@@ -78,12 +79,12 @@ static int hash_one(PyObject *v, PyObject *fallback, uint64_t *out) {
         Py_ssize_t len;
         const char *s = PyUnicode_AsUTF8AndSize(v, &len);
         if (s == NULL) return -1;
-        *out = pwhash_bytes((const unsigned char *)s, len, 0x04);
+        *out = pwhash_bytes((const unsigned char *)s, len, 0x04, salt);
         return 0;
     }
     if (PyBytes_Check(v)) {
         *out = pwhash_bytes((const unsigned char *)PyBytes_AS_STRING(v),
-                            PyBytes_GET_SIZE(v), 0x05);
+                            PyBytes_GET_SIZE(v), 0x05, salt);
         return 0;
     }
     /* numpy scalars, tuples, arrays, Json, ... -> python fallback */
@@ -101,7 +102,8 @@ static int hash_one(PyObject *v, PyObject *fallback, uint64_t *out) {
 
 static PyObject *hash_obj_array(PyObject *self, PyObject *args) {
     PyObject *arr_obj, *fallback;
-    if (!PyArg_ParseTuple(args, "OO", &arr_obj, &fallback)) return NULL;
+    unsigned long long salt = 0;
+    if (!PyArg_ParseTuple(args, "OO|K", &arr_obj, &fallback, &salt)) return NULL;
     PyArrayObject *arr = (PyArrayObject *)PyArray_FROM_OTF(
         arr_obj, NPY_OBJECT, NPY_ARRAY_IN_ARRAY);
     if (arr == NULL) return NULL;
@@ -116,7 +118,7 @@ static PyObject *hash_obj_array(PyObject *self, PyObject *args) {
     PyObject **data = (PyObject **)PyArray_DATA(arr);
     uint64_t *o = (uint64_t *)PyArray_DATA(out);
     for (npy_intp i = 0; i < n; i++) {
-        if (hash_one(data[i], fallback, &o[i]) < 0) {
+        if (hash_one(data[i], fallback, (uint64_t)salt, &o[i]) < 0) {
             Py_DECREF(arr);
             Py_DECREF(out);
             return NULL;

@@ -423,8 +423,16 @@ class LshVectorBackend(IndexBackend):
         )
 
         self.metric = metric
-        if metric in ("cos", "dot"):
-            # direction-sensitive metrics use hyperplane buckets
+        if metric == "dot":
+            # hyperplane buckets ignore magnitude, so the true max-inner-product
+            # neighbor can be excluded from every bucket; MIPS needs an ALSH
+            # transform we don't implement — use the exact brute-force index
+            raise ValueError(
+                "LshVectorBackend: metric='dot' is not supported (bucket recall "
+                "ignores vector magnitude); use BruteForceKnnFactory for "
+                "max-inner-product search"
+            )
+        if metric == "cos":
             self.bucketer = generate_cosine_lsh_bucketer(
                 dimension, M=n_and, L=n_or, seed=seed
             )
@@ -468,8 +476,6 @@ class LshVectorBackend(IndexBackend):
             dn = np.linalg.norm(cand_mat, axis=1)
             dn[dn == 0] = 1.0
             return (cand_mat @ q) / (dn * qn)
-        if self.metric == "dot":
-            return cand_mat @ q
         if self.metric in ("l2sq", "euclidean"):
             diff = cand_mat - q[None, :]
             return -(diff * diff).sum(axis=1)

@@ -22,7 +22,7 @@ def test_native_matches_python_mirror():
         pytest.skip("native kernel unavailable (no compiler)")
     arr = np.empty(len(ZOO), dtype=object)
     arr[:] = ZOO
-    native = keys._pwhash_native.hash_obj_array(arr, keys.stable_hash_obj)
+    native = keys._pwhash_native.hash_obj_array(arr, keys.stable_hash_obj, keys._HASH_SALT)
     pure = keys._hash_obj_ufunc(arr).astype(np.uint64)
     assert (native == pure).all(), [
         (v, int(a), int(b)) for v, a, b in zip(ZOO, native, pure) if a != b

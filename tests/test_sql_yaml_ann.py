@@ -212,14 +212,12 @@ def test_sql_duplicate_output_names_uniquified():
     assert set(star.column_names()) == {"k", "x", "k_1", "x_1"}
 
 
-def test_lsh_dot_metric():
+def test_lsh_rejects_dot_and_unknown_metrics():
     from pathway_tpu.stdlib.indexing._engine import LshVectorBackend
 
-    b = LshVectorBackend(dimension=4, metric="dot")
-    b.add(1, np.full(4, 2.0, dtype=np.float32), {})
-    b.add(2, np.full(4, 1.0, dtype=np.float32), {})
-    hits = b.search([np.ones(4, dtype=np.float32)], [2], [lambda md: True])[0]
-    assert [k for (k, _s) in hits] == [1, 2]  # larger dot wins
+    # MIPS via hyperplane buckets can exclude the true top hit: refused
+    with pytest.raises(ValueError, match="dot"):
+        LshVectorBackend(dimension=4, metric="dot")
     with pytest.raises(ValueError, match="unsupported metric"):
         LshVectorBackend(dimension=4, metric="bogus")
 

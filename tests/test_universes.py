@@ -1,0 +1,148 @@
+"""Universe algebra: subset/equality reasoning the relational layer leans on
+(SURVEY §7.3 'easy to get subtly wrong'; reference ``internals/universe.py`` +
+``universe_solver.py``)."""
+
+import pytest
+
+import pathway_tpu as pw
+from pathway_tpu.internals.universe import Universe, solver
+
+from utils import rows_of
+
+
+# ------------------------------------------------------------------ solver
+
+
+def test_subset_reflexive_transitive():
+    a = Universe()
+    b = a.superset()
+    c = b.superset()
+    s = solver()
+    assert s.query_is_subset(a, a)
+    assert s.query_is_subset(a, b) and s.query_is_subset(b, c)
+    assert s.query_is_subset(a, c)  # transitive
+    assert not s.query_is_subset(c, a)
+
+
+def test_equality_merges_subset_edges():
+    a = Universe()
+    b = a.superset()
+    c = Universe()
+    s = solver()
+    assert not s.query_is_subset(c, b)
+    s.register_equal(a, c)  # now c == a ⊆ b
+    assert s.query_are_equal(a, c)
+    assert s.query_is_subset(c, b)
+    # and the reverse merge direction keeps edges too
+    d = Universe()
+    e = d.superset()
+    f = Universe()
+    s.register_equal(f, d)
+    assert s.query_is_subset(f, e)
+
+
+def test_subset_diamond():
+    top = Universe()
+    l = top.subset()
+    r = top.subset()
+    bottom = l.subset()
+    s = solver()
+    s.register_subset(bottom, r)
+    assert s.query_is_subset(bottom, top)
+    assert s.query_is_subset(bottom, r) and s.query_is_subset(bottom, l)
+    assert not s.query_is_subset(l, r)
+
+
+def test_equality_chain_collapses():
+    a, b, c = Universe(), Universe(), Universe()
+    s = solver()
+    s.register_equal(a, b)
+    s.register_equal(b, c)
+    assert s.query_are_equal(a, c)
+    assert s.query_is_subset(a, c) and s.query_is_subset(c, a)
+
+
+# ------------------------------------------------------------------ tables
+
+
+class KV(pw.Schema):
+    k: int
+    v: int
+
+
+def _t():
+    return pw.debug.table_from_rows(KV, [(1, 10), (2, 20), (3, 30)])
+
+
+def test_filter_produces_subset_universe():
+    t = _t()
+    f = t.filter(t.v > 15)
+    s = solver()
+    assert s.query_is_subset(f._universe, t._universe)
+    assert not s.query_is_subset(t._universe, f._universe)
+    # chained filters stay transitively inside the source
+    g = f.filter(f.v > 25)
+    assert s.query_is_subset(g._universe, t._universe)
+
+
+def test_restrict_requires_known_subset():
+    t = _t()
+    other = pw.debug.table_from_rows(KV, [(1, 0)])
+    with pytest.raises(Exception):
+        t.restrict(other)  # unrelated universe: must refuse
+    promised = other.promise_universe_is_subset_of(t)
+    r = t.restrict(promised)
+    assert sorted(rows_of(r).elements()) == [(1, 10)]
+
+
+def test_same_universe_select_rejects_unrelated():
+    t = _t()
+    other = pw.debug.table_from_rows(KV, [(9, 9), (8, 8), (7, 7)])
+    with pytest.raises(Exception):
+        t.select(a=t.v, b=other.v)
+    # with_universe_of re-asserts equality (keys match: same sequential ids)
+    aligned = other.with_universe_of(t)
+    out = t.select(a=t.v, b=aligned.v)
+    assert sorted(rows_of(out).elements()) == [(10, 9), (20, 8), (30, 7)]
+
+
+def test_update_cells_needs_subset():
+    t = _t()
+    patch = t.filter(t.k == 2).select(v=t.v * 100)
+    updated = t.update_cells(patch)
+    assert sorted(rows_of(updated).elements()) == [(1, 10), (2, 2000), (3, 30)]
+
+
+def test_intersect_difference_universe_relations():
+    t = _t()
+    f = t.filter(t.v > 15)
+    s = solver()
+    ix = t.intersect(f)
+    assert s.query_is_subset(ix._universe, t._universe)
+    assert sorted(rows_of(ix).elements()) == [(2, 20), (3, 30)]
+    d = t.difference(f)
+    assert s.query_is_subset(d._universe, t._universe)
+    assert sorted(rows_of(d).elements()) == [(1, 10)]
+
+
+def test_join_left_id_only_subset_of_left():
+    t = _t()
+    names = pw.debug.table_from_rows(
+        pw.schema_from_types(k=int, name=str), [(1, "a"), (2, "b")]
+    )
+    j = t.join(names, t.k == names.k, id=t.id).select(v=t.v, name=names.name)
+    s = solver()
+    assert s.query_is_subset(j._universe, t._universe)
+
+
+def test_sort_reinserted_key_does_not_duplicate():
+    """A key re-inserted across ticks (duplicate rows in a value-keyed stream)
+    must hold ONE position in the order, keeping the prev/next chain linear."""
+    lines = ["v | __time__ | __diff__"] + [
+        f"{(i * 37) % 101} | {i // 10} | 1" for i in range(500)
+    ]
+    t = pw.debug.table_from_markdown("\n".join(lines))
+    out = rows_of(t.sort(key=t.v))
+    assert len(out) == 101
+    assert sum(1 for r in out.elements() if r[0] is None) == 1
+    assert sum(1 for r in out.elements() if r[1] is None) == 1
