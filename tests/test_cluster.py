@@ -176,3 +176,53 @@ def test_cluster_dead_peer_raises_not_hangs(pipeline_script, tmp_path):
         p.kill()
         raise AssertionError("process 0 hung forever on a dead peer")
     assert p.returncode != 0
+
+
+_INDEX_PIPELINE = textwrap.dedent(
+    """
+    import sys
+
+    import numpy as np
+
+    import pathway_tpu as pw
+    from pathway_tpu.stdlib.indexing import BruteForceKnnFactory
+
+    out = sys.argv[1]
+
+    rng = np.random.default_rng(11)
+    vecs = rng.normal(size=(48, 8)).astype(np.float32)
+    vecs[10:30] = vecs[10]  # identical rows: score ties at the k boundary
+    docs = pw.debug.table_from_rows(
+        pw.schema_from_types(emb=np.ndarray), [(v,) for v in vecs]
+    )
+    qs = rng.normal(size=(6, 8)).astype(np.float32)
+    qs[0] = vecs[10]
+    queries = pw.debug.table_from_rows(
+        pw.schema_from_types(emb=np.ndarray), [(q,) for q in qs]
+    )
+    index = BruteForceKnnFactory(dimensions=8, reserved_space=128).build_index(
+        docs.emb, docs
+    )
+    reply = index.inner_index.query(queries.emb, number_of_matches=5)
+    flat = reply.select(
+        r=pw.apply(
+            lambda t: ";".join(f"{int(k)}:{float(s).hex()}" for (k, s) in t),
+            reply._pw_index_reply,
+        )
+    )
+    pw.io.fs.write(flat, out + ".reply.csv", format="csv")
+    pw.run()
+    """
+)
+
+
+def test_cluster_sharded_index_byte_identical(tmp_path):
+    """Docs shard across processes and queries BROADCAST over TCP; the merged
+    replies must match single-process byte for byte (ties included)."""
+    path = tmp_path / "index_pipeline.py"
+    path.write_text(_INDEX_PIPELINE)
+    solo = str(tmp_path / "solo")
+    _run_cluster(str(path), solo, processes=1, threads=1, timeout=180)
+    dist = str(tmp_path / "dist")
+    _run_cluster(str(path), dist, processes=2, threads=1, timeout=180)
+    assert _read(solo, ".reply.csv") == _read(dist, ".reply.csv")
