@@ -88,6 +88,7 @@ from pathway_tpu import debug, demo, io, persistence, stdlib, universes
 from pathway_tpu.stdlib import temporal, indexing, ml, graphs, statistical, stateful
 from pathway_tpu.stdlib import utils as utils
 from pathway_tpu.stdlib.utils.async_transformer import AsyncTransformer
+from pathway_tpu.stdlib.utils.pandas_transformer import pandas_transformer
 from pathway_tpu.internals.iterate import iterate, iterate_universe
 from pathway_tpu.internals.yaml_loader import load_yaml
 
@@ -114,8 +115,11 @@ def sql(query: str, **tables):
     return _sql(query, **tables)
 
 
-def enable_interactive_mode() -> None:
-    raise NotImplementedError("interactive mode is not available yet")
+from pathway_tpu.internals.interactive import (  # noqa: E402
+    LiveTable,
+    enable_interactive_mode,
+    live,
+)
 
 
 def set_license_key(key: str | None) -> None:
@@ -176,6 +180,10 @@ __all__ = [
     "utils",
     "AsyncTransformer",
     "load_yaml",
+    "LiveTable",
+    "enable_interactive_mode",
+    "live",
+    "pandas_transformer",
     "temporal",
     "indexing",
     "universes",

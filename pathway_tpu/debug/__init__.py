@@ -258,7 +258,16 @@ def _capture(table: Table, **run_kwargs: Any) -> CapturedTable:
         n_workers=run_kwargs.pop("n_workers", None),
         autocommit_duration_ms=run_kwargs.pop("autocommit_duration_ms", 5),
     )
-    runtime.run([lnode])
+    # debug capture always inspects ERROR values rather than aborting —
+    # regardless of what policy an earlier pw.run left behind
+    from pathway_tpu.internals import errors as _errors
+
+    prev_policy = _errors.get_error_policy()
+    _errors.set_error_policy(False)
+    try:
+        runtime.run([lnode])
+    finally:
+        _errors.set_error_policy(prev_policy)
     return CapturedTable(cols, holder["node"])
 
 

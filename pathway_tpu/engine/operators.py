@@ -823,7 +823,9 @@ class CombineNode(Node):
         out_keys: list[int] = []
         out_diffs: list[int] = []
         out_rows: list[tuple] = []
-        for k in affected:
+        # sorted: set iteration order must not leak into order-sensitive
+        # consumers (tuple reducers downstream)
+        for k in sorted(affected):
             rows = [st.get(k) for st in self.side_state]
             present = True
             for spec, row in zip(self.sides, rows):

@@ -119,6 +119,30 @@ def run(
         terminate_on_error = get_pathway_config().terminate_on_error
     prev_policy = _errors.get_error_policy()
     _errors.set_error_policy(terminate_on_error)
+
+    from pathway_tpu.internals import interactive as _interactive
+
+    if _interactive.is_interactive_mode_enabled():
+        # notebook mode: the runtime loops on a daemon thread; LiveTables
+        # update as ticks land and the handle stops the run
+        import threading as _threading
+
+        outputs = list(G.outputs)
+
+        def _bg():
+            try:
+                runtime.run(outputs)
+            finally:
+                # NOTE: the error policy deliberately stays as configured —
+                # restoring a process-global from a daemon thread would race
+                # with any later pw.run on the main thread
+                if http_server is not None:
+                    http_server.stop()
+
+        th = _threading.Thread(target=_bg, daemon=True)
+        th.start()
+        return _interactive.InteractiveRunHandle(runtime, th)
+
     try:
         runtime.run(list(G.outputs))
     finally:

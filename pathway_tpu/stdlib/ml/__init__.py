@@ -1,7 +1,20 @@
-"""ML stdlib (reference: ``python/pathway/stdlib/ml/``): LSH KNN classifiers.
-The dense TPU-native KNN index lives in ``pathway_tpu.ops.knn`` /
-``stdlib.indexing`` — classifiers here are the sub-linear LSH pruning path."""
+"""ML stdlib (reference: ``python/pathway/stdlib/ml/``): LSH KNN classifiers,
+the legacy KNNIndex wrapper, fuzzy joins, HMM decoding. The dense TPU-native
+KNN index lives in ``pathway_tpu.ops.knn`` / ``stdlib.indexing``."""
 
-from pathway_tpu.stdlib.ml import classifiers
+from pathway_tpu.stdlib.ml import classifiers, smart_table_ops
+from pathway_tpu.stdlib.ml.index import KNNIndex
 
-__all__ = ["classifiers"]
+__all__ = ["KNNIndex", "classifiers", "smart_table_ops"]
+
+
+def __getattr__(name):  # hmm pulls networkx; import lazily
+    if name == "hmm":
+        from pathway_tpu.stdlib.ml import hmm
+
+        return hmm
+    if name == "datasets":
+        from pathway_tpu.stdlib.ml import datasets
+
+        return datasets
+    raise AttributeError(name)

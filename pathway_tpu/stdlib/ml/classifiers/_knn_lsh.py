@@ -81,14 +81,21 @@ def knn_lsh_generic_classifier_train(data: pw.Table, bucketer, distance=_euclide
             mat = np.stack([np.asarray(v, dtype=np.float64) for v in vecs])
             dists = distance(mat, np.asarray(qv, dtype=np.float64))
             order = np.lexsort((np.asarray(cands, dtype=np.uint64), dists))[:k]
-            return tuple(cands[i] for i in order)
+            return (
+                tuple(cands[i] for i in order),
+                tuple(float(dists[i]) for i in order),
+            )
 
         rekeyed = grouped.with_id(grouped.query)
-        knns = rekeyed.select(
-            knns_ids=pw.apply(topk, rekeyed.qv, rekeyed.cands, rekeyed.vecs)
+        pair = rekeyed.select(
+            p=pw.apply(topk, rekeyed.qv, rekeyed.cands, rekeyed.vecs)
         )
-        # queries with zero candidates still get a row (empty tuple)
-        return queries.select(knns_ids=()).update_rows(knns)
+        knns = pair.select(
+            knns_ids=pw.apply(lambda p: p[0], pair.p),
+            knns_dists=pw.apply(lambda p: p[1], pair.p),
+        )
+        # queries with zero candidates still get a row (empty tuples)
+        return queries.select(knns_ids=(), knns_dists=()).update_rows(knns)
 
     return query_fn
 

@@ -1,0 +1,16 @@
+"""Dataset download helpers (reference: ``stdlib/ml/datasets/``). This image
+has no network egress, so fetching is dependency-gated; local files load."""
+
+from __future__ import annotations
+
+import os
+
+
+def load_lsh_test_data(path: str | None = None):
+    if path and os.path.exists(path):
+        import numpy as np
+
+        return np.load(path)
+    raise NotImplementedError(
+        "dataset download requires network access; pass a local path instead"
+    )

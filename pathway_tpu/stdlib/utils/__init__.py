@@ -9,6 +9,7 @@ from pathway_tpu.stdlib.utils.col import (
     unpack_col,
 )
 from pathway_tpu.stdlib.utils.filtering import argmax_rows, argmin_rows
+from pathway_tpu.stdlib.utils.pandas_transformer import pandas_transformer
 
 __all__ = [
     "AsyncTransformer",
@@ -20,5 +21,6 @@ __all__ = [
     "filtering",
     "groupby_reduce_majority",
     "multiapply_all_rows",
+    "pandas_transformer",
     "unpack_col",
 ]

@@ -230,3 +230,54 @@ class AdaptiveRAGQuestionAnswerer(BaseRAGQuestionAnswerer):
         return combined.select(result=answers)
 
     answer = answer_query
+
+
+class RAGClient:
+    """HTTP client for the RAG question-answering servers (reference
+    ``question_answering.py:879``). Either (host, port) or url."""
+
+    def __init__(
+        self,
+        host: str | None = None,
+        port: int | None = None,
+        url: str | None = None,
+        timeout: int | None = 90,
+        additional_headers: dict | None = None,
+    ):
+        err = "Either (`host` and `port`) or `url` must be provided, but not both."
+        if url is not None:
+            if host is not None or port is not None:
+                raise ValueError(err)
+            self.url = url
+        else:
+            if host is None or port is None:
+                raise ValueError(err)
+            self.url = f"http://{host}:{port}"
+        self.timeout = timeout
+        self.headers = additional_headers or {}
+
+    def _post(self, route: str, payload: dict):
+        from pathway_tpu.xpacks.llm.vector_store import post_json
+
+        return post_json(self.url, route, payload, self.timeout or 90, self.headers)
+
+    def answer(self, prompt: str, filters: str | None = None, **kwargs):
+        payload = {"prompt": prompt, **kwargs}
+        if filters is not None:
+            payload["filters"] = filters
+        return self._post("/v2/answer", payload)
+
+    def retrieve(self, query: str, k: int = 3, metadata_filter: str | None = None):
+        payload = {"query": query, "k": k}
+        if metadata_filter is not None:
+            payload["metadata_filter"] = metadata_filter
+        return self._post("/v1/retrieve", payload)
+
+    def statistics(self):
+        return self._post("/v1/statistics", {})
+
+    def list_documents(self, filters: str | None = None):
+        payload = {}
+        if filters is not None:
+            payload["metadata_filter"] = filters
+        return self._post("/v2/list_documents", payload)

@@ -52,6 +52,17 @@ class VectorStoreServer:
         return server.run(threaded=threaded, with_cache=with_cache, **kwargs)
 
 
+def post_json(url: str, route: str, payload: dict, timeout: float, headers: dict | None = None) -> Any:
+    """Shared POST-JSON helper for the xpack HTTP clients."""
+    hdrs = {"Content-Type": "application/json"}
+    hdrs.update(headers or {})
+    req = urllib.request.Request(
+        url.rstrip("/") + route, data=json.dumps(payload).encode(), headers=hdrs
+    )
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return json.loads(r.read().decode())
+
+
 class VectorStoreClient:
     """HTTP client for the vector-store endpoints (reference API)."""
 
@@ -60,13 +71,7 @@ class VectorStoreClient:
         self.timeout = timeout
 
     def _post(self, route: str, payload: dict) -> Any:
-        req = urllib.request.Request(
-            self.url + route,
-            data=json.dumps(payload).encode(),
-            headers={"Content-Type": "application/json"},
-        )
-        with urllib.request.urlopen(req, timeout=self.timeout) as r:
-            return json.loads(r.read().decode())
+        return post_json(self.url, route, payload, self.timeout)
 
     def query(self, query: str, k: int = 3, metadata_filter: str | None = None, filepath_globpattern: str | None = None):
         return self._post(

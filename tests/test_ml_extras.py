@@ -1,0 +1,180 @@
+"""KNNIndex wrapper, fuzzy joins, HMM reducer, pandas_transformer,
+interactive mode (reference: ``stdlib/ml/index.py``, ``smart_table_ops/``,
+``hmm.py``, ``utils/pandas_transformer.py``, ``internals/interactive.py``)."""
+
+import time
+
+import numpy as np
+import pytest
+
+import pathway_tpu as pw
+from pathway_tpu.internals.parse_graph import G
+
+from utils import rows_of
+
+
+def test_knn_index_collapsed_and_flat():
+    rng = np.random.default_rng(5)
+    vecs = np.vstack(
+        [rng.normal(0, 0.1, (10, 6)) + 1, rng.normal(0, 0.1, (10, 6)) - 1]
+    ).astype(np.float32)
+    data = pw.debug.table_from_rows(
+        pw.schema_from_types(emb=np.ndarray, label=str),
+        [(v, "P" if v[0] > 0 else "N") for v in vecs],
+    )
+    from pathway_tpu.stdlib.ml.index import KNNIndex
+
+    index = KNNIndex(data.emb, data, n_dimensions=6, n_or=8, n_and=4, bucket_length=2.0)
+    queries = pw.debug.table_from_rows(
+        pw.schema_from_types(emb=np.ndarray), [(np.full(6, 0.9, dtype=np.float32),)]
+    )
+    collapsed = index.get_nearest_items(queries.emb, k=3)
+    rows = list(rows_of(collapsed).elements())
+    assert len(rows) == 1
+    labels = rows[0][collapsed.column_names().index("label")]
+    assert set(labels) == {"P"} and len(labels) == 3
+
+    flat = index.get_nearest_items(queries.emb, k=3, collapse_rows=False)
+    frows = list(rows_of(flat).elements())
+    assert len(frows) == 3
+
+
+def test_fuzzy_match_tables_pairs_similar_rows():
+    from pathway_tpu.stdlib.ml.smart_table_ops import fuzzy_match_tables
+
+    left = pw.debug.table_from_rows(
+        pw.schema_from_types(name=str),
+        [("Apple Inc.",), ("Microsoft Corp",), ("Banana republic",)],
+    )
+    right = pw.debug.table_from_rows(
+        pw.schema_from_types(company=str),
+        [("apple incorporated",), ("MICROSOFT corporation",), ("orange llc",)],
+    )
+    m = fuzzy_match_tables(left, right)
+    lp = pw.debug.table_to_pandas(left)
+    rp = pw.debug.table_to_pandas(right)
+    got = {
+        (lp.loc[int(l)]["name"], rp.loc[int(r)]["company"])
+        for (l, r, _w) in rows_of(m).elements()
+    }
+    assert got == {
+        ("Apple Inc.", "apple incorporated"),
+        ("Microsoft Corp", "MICROSOFT corporation"),
+    }
+
+
+def test_fuzzy_self_match_excludes_identity():
+    from pathway_tpu.stdlib.ml.smart_table_ops import fuzzy_self_match
+
+    t = pw.debug.table_from_rows(
+        pw.schema_from_types(name=str),
+        [("data pipeline alpha",), ("data pipeline beta",), ("zebra",)],
+    )
+    m = fuzzy_self_match(t)
+    pairs = list(rows_of(m).elements())
+    assert pairs, "similar rows must match"
+    assert all(int(l) != int(r) for (l, r, _w) in pairs)
+
+
+def test_hmm_reducer_decodes_states():
+    nx = pytest.importorskip("networkx")
+    import math
+
+    from pathway_tpu.stdlib.ml.hmm import create_hmm_reducer
+
+    def emission(state):
+        # HUNGRY manuls are mostly GRUMPY; FULL manuls mostly HAPPY
+        table = {
+            "HUNGRY": {"GRUMPY": 0.9, "HAPPY": 0.1},
+            "FULL": {"GRUMPY": 0.2, "HAPPY": 0.8},
+        }[state]
+        return lambda obs: math.log(table[obs])
+
+    g = nx.DiGraph()
+    for s in ("HUNGRY", "FULL"):
+        g.add_node(s, calc_emission_log_ppb=emission(s))
+    for a in ("HUNGRY", "FULL"):
+        for b in ("HUNGRY", "FULL"):
+            g.add_edge(a, b, log_transition_ppb=math.log(0.6 if a == b else 0.4))
+    g.graph["start_nodes"] = ["HUNGRY", "FULL"]
+
+    t = pw.debug.table_from_markdown(
+        """
+        observation | __time__
+        HAPPY | 2
+        HAPPY | 4
+        GRUMPY | 6
+        GRUMPY | 8
+        """
+    )
+    reducer = create_hmm_reducer(g)
+    decoded = t.reduce(path=reducer(t.observation))
+    rows = list(rows_of(decoded).elements())
+    assert rows[0][0] == ("FULL", "FULL", "HUNGRY", "HUNGRY")
+
+
+def test_pandas_transformer_roundtrip():
+    @pw.pandas_transformer(output_schema=pw.schema_from_types(doubled=int))
+    def double(df):
+        return df.assign(doubled=df["v"] * 2)[["doubled"]]
+
+    t = pw.debug.table_from_rows(pw.schema_from_types(v=int), [(1,), (2,), (3,)])
+    out = double(t)
+    assert sorted(rows_of(out).elements()) == [(2,), (4,), (6,)]
+
+
+def test_interactive_mode_live_table():
+    import pathway_tpu.internals.interactive as interactive
+
+    G.clear()
+
+    class S(pw.Schema):
+        x: int
+
+    class Subj(pw.io.python.ConnectorSubject):
+        def run(self):
+            for i in range(5):
+                self.next(x=i)
+                time.sleep(0.03)
+
+    t = pw.io.python.read(Subj(), schema=S)
+    g = t.reduce(s=pw.reducers.sum(t.x))
+    view = pw.live(g)
+    prev = interactive._interactive
+    interactive._interactive = True
+    try:
+        handle = pw.run(monitoring_level="none")
+        assert handle is not None and hasattr(handle, "stop")
+        deadline = time.time() + 15
+        while time.time() < deadline:
+            df = view.to_pandas()
+            if len(df) and int(df.iloc[0]["s"]) == 10:
+                break
+            time.sleep(0.05)
+        else:
+            raise AssertionError(f"live view never converged: {view.to_pandas()}")
+        handle.join(15)
+        assert not handle.alive
+    finally:
+        interactive._interactive = prev
+
+
+def test_knn_index_with_distances():
+    rng = np.random.default_rng(2)
+    vecs = (rng.normal(0, 0.05, (8, 4)) + 1).astype(np.float32)
+    data = pw.debug.table_from_rows(
+        pw.schema_from_types(emb=np.ndarray), [(v,) for v in vecs]
+    )
+    from pathway_tpu.stdlib.ml.index import KNNIndex
+
+    index = KNNIndex(data.emb, data, n_dimensions=4, n_or=6, n_and=3, bucket_length=3.0)
+    queries = pw.debug.table_from_rows(
+        pw.schema_from_types(emb=np.ndarray), [(np.ones(4, dtype=np.float32),)]
+    )
+    out = index.get_nearest_items(queries.emb, k=2, with_distances=True)
+    rows = list(rows_of(out).elements())
+    dist_idx = out.column_names().index("dist")
+    dists = rows[0][dist_idx]
+    assert len(dists) == 2 and dists[0] <= dists[1]
+    with pytest.raises(NotImplementedError, match="metadata"):
+        index.get_nearest_items(queries.emb, metadata_filter="x")
