@@ -286,3 +286,36 @@ class SortedCounts:
         return uniq, prev, new
 
 
+
+
+class ColumnarKeyedStore:
+    """Keyed single-row-per-key columnar map over :class:`ColumnarMultimap`
+    (jk == rk == the row key): upserts tombstone the previous row, probes
+    return presence masks + key-aligned column arrays."""
+
+    def __init__(self, n_cols: int):
+        self.mm = ColumnarMultimap(n_cols)
+
+    def __len__(self) -> int:
+        return len(self.mm)
+
+    def delete(self, keys: np.ndarray) -> None:
+        self.mm.delete(keys, keys)
+
+    def upsert(self, keys: np.ndarray, cols: list[np.ndarray]) -> None:
+        self.mm.delete(keys, keys)
+        self.mm.insert(keys, keys, cols)
+
+    def get(self, keys: np.ndarray) -> tuple[np.ndarray, list[np.ndarray]]:
+        """(present bool[n], aligned object columns with None where absent)."""
+        q_idx, _rk, cols = self.mm.match(keys)
+        present = np.zeros(len(keys), dtype=bool)
+        present[q_idx] = True
+        aligned: list[np.ndarray] = []
+        for c in cols:
+            out = np.empty(len(keys), dtype=object)
+            if len(q_idx):
+                # list() keeps datetime64 scalars intact in object storage
+                out[q_idx] = list(c) if c.dtype.kind in ("M", "m") else c
+            aligned.append(out)
+        return present, aligned

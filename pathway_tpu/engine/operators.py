@@ -25,7 +25,7 @@ from pathway_tpu.engine.blocks import (
     group_starts,
     make_column,
 )
-from pathway_tpu.engine.colstore import ColumnarMultimap, SortedCounts
+from pathway_tpu.engine.colstore import ColumnarKeyedStore, ColumnarMultimap, SortedCounts
 from pathway_tpu.engine.graph import END_OF_STREAM, SOLO, Node
 from pathway_tpu.engine.reducers_impl import ReducerImpl
 from pathway_tpu.internals.keys import combine_keys, row_keys, splitmix64
@@ -777,86 +777,159 @@ class SideSpec:
 
 
 class CombineNode(Node):
-    """Key-aligned N-way combine.
+    """Key-aligned N-way combine, fully columnar.
 
     One node covers the reference's same-universe operator family:
     ``update_rows``/``update_cells`` (override semantics), ``restrict``/
     ``intersect`` (required sides), ``difference`` (negated side), ``having``,
-    and cross-table rowwise selects over equal universes.
+    and cross-table aligned selects over equal universes. State per side is a
+    :class:`ColumnarKeyedStore`; a tick applies every side's delta block, then
+    re-combines only the affected keys with mode-specific vectorized assembly.
+    Change detection uses row digests (the same digest discipline
+    ``consolidate`` already relies on).
+
+    Modes: ``"side0"`` (emit side 0's row under the presence gate),
+    ``"update_rows"`` (later sides override whole rows),
+    ``"update_cells"`` (side 1 overrides the listed columns of side 0),
+    ``"concat"`` (concatenate all sides' rows in order).
     """
 
     name = "combine"
 
-    snapshot_attrs = ("side_state", "emitted")
+    snapshot_attrs = ("stores", "emitted")
 
     def __init__(
         self,
-        sides: list[SideSpec],
+        sides: list["SideSpec"],
         side_columns: list[list[str]],
-        combine_fn: Callable[[int, list[tuple | None]], tuple | None],
+        mode: str,
         out_columns: list[str],
         np_dtypes: dict | None = None,
+        override_positions: list[tuple[int, int]] | None = None,
     ):
         super().__init__(n_inputs=len(sides))
         self.sides = sides
         self.side_columns = side_columns
-        self.combine_fn = combine_fn
+        self.mode = mode
         self.out_columns = out_columns
         self.np_dtypes = np_dtypes or {}
-        self.side_state: list[dict[int, tuple]] = [dict() for _ in sides]
-        self.emitted: dict[int, tuple] = {}
+        # update_cells: (index in side-1 columns, index in out columns)
+        self.override_positions = override_positions or []
+        # update_rows: per-side (src idx, out idx) by NAME — a side whose
+        # column order differs from out_columns must not write cross-column
+        out_pos = {n: i for i, n in enumerate(out_columns)}
+        self._side_out_maps = [
+            [(j, out_pos[n]) for j, n in enumerate(cols) if n in out_pos]
+            for cols in side_columns
+        ]
+        self.stores = [ColumnarKeyedStore(len(cols)) for cols in side_columns]
+        self.emitted = ColumnarKeyedStore(len(out_columns))
 
     def process(self, inputs, time):
-        affected: set[int] = set()
+        affected_parts: list[np.ndarray] = []
         for port, batch in enumerate(inputs):
-            if batch is None:
+            if batch is None or not len(batch):
                 continue
-            state = self.side_state[port]
-            cols = [batch.data[c] for c in self.side_columns[port]]
-            for i in range(len(batch)):
-                k = int(batch.keys[i])
-                if batch.diffs[i] > 0:
-                    state[k] = tuple(c[i] for c in cols)
-                else:
-                    state.pop(k, None)
-                affected.add(k)
-        out_keys: list[int] = []
-        out_diffs: list[int] = []
-        out_rows: list[tuple] = []
-        # sorted: set iteration order must not leak into order-sensitive
-        # consumers (tuple reducers downstream)
-        for k in sorted(affected):
-            rows = [st.get(k) for st in self.side_state]
-            present = True
-            for spec, row in zip(self.sides, rows):
-                has = row is not None
-                if spec.negated:
-                    has = not has
-                if spec.required and not has:
-                    present = False
-                    break
-            new = self.combine_fn(k, rows) if present else None
-            old = self.emitted.get(k)
-            if not _tuple_differs(old, new):
+            # same-tick insert+retract of one row must net out BEFORE the
+            # delete-then-insert application order below
+            batch = consolidate(batch)
+            if not len(batch):
                 continue
-            if old is not None:
-                out_keys.append(k)
-                out_diffs.append(-1)
-                out_rows.append(old)
-                del self.emitted[k]
-            if new is not None:
-                out_keys.append(k)
-                out_diffs.append(1)
-                out_rows.append(new)
-                self.emitted[k] = new
-        if not out_keys:
+            store = self.stores[port]
+            dels = np.flatnonzero(batch.diffs < 0)
+            if len(dels):
+                store.delete(batch.keys[dels])
+            ins = np.flatnonzero(batch.diffs > 0)
+            if len(ins):
+                cols = [batch.data[c][ins] for c in self.side_columns[port]]
+                store.upsert(batch.keys[ins], cols)
+            affected_parts.append(batch.keys)
+        if not affected_parts:
             return []
-        return [
-            DeltaBatch.from_rows(
-                out_keys, out_rows, self.out_columns, time,
-                diffs=out_diffs, np_dtypes=self.np_dtypes,
-            )
+        keys = np.unique(np.concatenate(affected_parts))
+
+        presents: list[np.ndarray] = []
+        aligned: list[list[np.ndarray]] = []
+        for store in self.stores:
+            p, cols = store.get(keys)
+            presents.append(p)
+            aligned.append(cols)
+
+        gate = np.ones(len(keys), dtype=bool)
+        for spec, present in zip(self.sides, presents):
+            if spec.required:
+                gate &= ~present if spec.negated else present
+        # a key with no contributing side left (fully retracted) emits nothing
+        contributing = [
+            p for spec, p in zip(self.sides, presents) if not spec.negated
         ]
+        if contributing:
+            gate &= np.logical_or.reduce(contributing)
+
+        new_cols = self._assemble(keys, presents, aligned)
+        was, old_cols = self.emitted.get(keys)
+
+        changed = np.ones(len(keys), dtype=bool)
+        both = was & gate
+        if both.any():
+            idx = np.flatnonzero(both)
+            new_d = row_keys([c[idx] for c in new_cols], n=len(idx))
+            old_d = row_keys([c[idx] for c in old_cols], n=len(idx))
+            changed[idx] = new_d != old_d
+
+        retract = was & (~gate | changed)
+        insert = gate & (~was | changed)
+        r_idx = np.flatnonzero(retract)
+        i_idx = np.flatnonzero(insert)
+        if not len(r_idx) and not len(i_idx):
+            return []
+
+        if len(r_idx):
+            self.emitted.delete(keys[r_idx])
+        if len(i_idx):
+            self.emitted.upsert(keys[i_idx], [c[i_idx] for c in new_cols])
+
+        out_keys = np.concatenate([keys[r_idx], keys[i_idx]])
+        out_diffs = np.concatenate(
+            [np.full(len(r_idx), -1, dtype=np.int64), np.ones(len(i_idx), dtype=np.int64)]
+        )
+        data: dict[str, np.ndarray] = {}
+        for j, name in enumerate(self.out_columns):
+            arr = concat_cols([old_cols[j][r_idx], new_cols[j][i_idx]])
+            npd = self.np_dtypes.get(name)
+            if npd is not None and npd != np.dtype(object) and arr.dtype == object:
+                arr = make_column(arr.tolist(), npd)
+            data[name] = arr
+        return [DeltaBatch(out_keys, out_diffs, data, time)]
+
+    def _assemble(
+        self,
+        keys: np.ndarray,
+        presents: list[np.ndarray],
+        aligned: list[list[np.ndarray]],
+    ) -> list[np.ndarray]:
+        if self.mode == "side0":
+            return aligned[0]
+        if self.mode == "update_rows":
+            # later sides override whole rows where present (column mapping by
+            # NAME: side orders may differ from out_columns)
+            out = [np.empty(len(keys), dtype=object) for _ in self.out_columns]
+            for src_j, dst_j in self._side_out_maps[0]:
+                out[dst_j][:] = aligned[0][src_j]
+            for s in range(1, len(aligned)):
+                idx = np.flatnonzero(presents[s])
+                for src_j, dst_j in self._side_out_maps[s]:
+                    out[dst_j][idx] = aligned[s][src_j][idx]
+            return out
+        if self.mode == "update_cells":
+            out = [c.copy() for c in aligned[0]]
+            idx = np.flatnonzero(presents[1])
+            for src_j, dst_j in self.override_positions:
+                out[dst_j][idx] = aligned[1][src_j][idx]
+            return out
+        if self.mode == "concat":
+            return [c for cols in aligned for c in cols]
+        raise ValueError(f"combine: unknown mode {self.mode!r}")
 
 
 # ---------------------------------------------------------------------------- join

@@ -310,16 +310,12 @@ class Table:
         if set(other.column_names()) != set(self.column_names()):
             raise ValueError("update_rows requires identical columns")
         cols = self.column_names()
-
-        def combine(key: int, rows: list[tuple | None]) -> tuple | None:
-            return rows[1] if rows[1] is not None else rows[0]
-
         uni = self._universe.superset()
         solver().register_subset(other._universe, uni)
         return _combine_tables(
             [self, other],
             [ops.SideSpec(required=False), ops.SideSpec(required=False)],
-            combine,
+            "update_rows",
             cols,
             {n: self._schema.np_dtypes()[n] for n in cols},
             schema_mod.schema_from_dtypes(
@@ -336,22 +332,11 @@ class Table:
         cols = self.column_names()
         other_cols = other.column_names()
         positions = {n: i for i, n in enumerate(cols)}
-
-        def combine(key: int, rows: list[tuple | None]) -> tuple | None:
-            base, over = rows
-            if base is None:
-                return None
-            if over is None:
-                return base
-            merged = list(base)
-            for j, n in enumerate(other_cols):
-                merged[positions[n]] = over[j]
-            return tuple(merged)
-
+        override_positions = [(j, positions[n]) for j, n in enumerate(other_cols)]
         return _combine_tables(
             [self, other],
             [ops.SideSpec(required=True), ops.SideSpec(required=False)],
-            combine,
+            "update_cells",
             cols,
             self._schema.np_dtypes(),
             schema_mod.schema_from_dtypes(
@@ -364,6 +349,7 @@ class Table:
             ),
             self._universe,
             name="update_cells",
+            override_positions=override_positions,
         )
 
     def restrict(self, other: "Table", strict: bool = True) -> "Table":
@@ -375,14 +361,10 @@ class Table:
                 "this table's; use promise_universe_is_subset_of first"
             )
         cols = self.column_names()
-
-        def combine(key: int, rows: list[tuple | None]) -> tuple | None:
-            return rows[0]
-
         return _combine_tables(
             [self, other],
             [ops.SideSpec(required=True), ops.SideSpec(required=True)],
-            combine,
+            "side0",
             cols,
             self._schema.np_dtypes(),
             self._schema,
@@ -392,14 +374,10 @@ class Table:
 
     def intersect(self, *tables: "Table") -> "Table":
         cols = self.column_names()
-
-        def combine(key: int, rows: list[tuple | None]) -> tuple | None:
-            return rows[0]
-
         return _combine_tables(
             [self, *tables],
             [ops.SideSpec(required=True)] * (1 + len(tables)),
-            combine,
+            "side0",
             cols,
             self._schema.np_dtypes(),
             self._schema,
@@ -409,14 +387,10 @@ class Table:
 
     def difference(self, other: "Table") -> "Table":
         cols = self.column_names()
-
-        def combine(key: int, rows: list[tuple | None]) -> tuple | None:
-            return rows[0]
-
         return _combine_tables(
             [self, other],
             [ops.SideSpec(required=True), ops.SideSpec(required=True, negated=True)],
-            combine,
+            "side0",
             cols,
             self._schema.np_dtypes(),
             self._schema,
@@ -744,16 +718,20 @@ def _compile_key_program_raw(e: ColumnExpression, source: Table) -> Callable[[De
 def _combine_tables(
     tables: list[Table],
     sides: list[ops.SideSpec],
-    combine_fn: Callable,
+    mode: str,
     out_columns: list[str],
     np_dtypes: dict,
     schema: schema_mod.SchemaMetaclass,
     universe: Universe,
     name: str,
+    override_positions: list[tuple[int, int]] | None = None,
 ) -> Table:
     side_columns = [t.column_names() for t in tables]
     node = LogicalNode(
-        lambda: ops.CombineNode(sides, side_columns, combine_fn, out_columns, np_dtypes),
+        lambda: ops.CombineNode(
+            sides, side_columns, mode, out_columns, np_dtypes,
+            override_positions=override_positions,
+        ),
         [t._node for t in tables],
         name=name,
     )
@@ -781,18 +759,10 @@ def _multi_table_select(
     for i, t in enumerate(tables):
         prefixed.extend(f"__s{i}__{n}" for n in t.column_names())
 
-    def combine(key: int, rows: list[tuple | None]) -> tuple | None:
-        out: list[Any] = []
-        for r, t in zip(rows, tables):
-            if r is None:
-                return None
-            out.extend(r)
-        return tuple(out)
-
     aligned = _combine_tables(
         tables,
         [ops.SideSpec(required=True)] * len(tables),
-        combine,
+        "concat",
         prefixed,
         {},
         schema_mod.schema_from_dtypes({p: dt.ANY for p in prefixed}),

@@ -206,3 +206,44 @@ def test_empty_table():
     t = pw.Table.empty(x=int)
     assert rows_of(t) == {}
     assert len(rows_of(t)) == 0
+
+
+def test_combine_same_tick_insert_retract_nets_out():
+    """A +1/-1 pair for one key within one tick must leave no trace."""
+    import numpy as np
+
+    from pathway_tpu.engine.blocks import DeltaBatch
+    from pathway_tpu.engine.operators import CombineNode, SideSpec
+
+    n = CombineNode(
+        [SideSpec(required=False), SideSpec(required=False)],
+        [["a"], ["a"]],
+        "update_rows",
+        ["a"],
+        {"a": np.dtype(np.float64)},
+    )
+    b = DeltaBatch(
+        np.array([7, 7], dtype=np.uint64),
+        np.array([1, -1]),
+        {"a": np.array([1.0, 1.0])},
+        0,
+    )
+    assert n.process([b, None], 0) == []
+    assert len(n.emitted) == 0
+
+
+def test_update_rows_with_swapped_column_order():
+    t1 = pw.debug.table_from_rows(pw.schema_from_types(a=int, b=int), [(10, 20)])
+    t2 = t1.select(b=t1.b * 10, a=t1.a * 10)  # column order b, a
+    out = t1.update_rows(t2)
+    assert sorted(rows_of(out).elements()) == [(100, 200)]
+
+
+def test_update_rows_output_stays_typed():
+    t1 = pw.debug.table_from_rows(pw.schema_from_types(a=float), [(1.5,), (2.5,)])
+    t2 = pw.debug.table_from_rows(pw.schema_from_types(a=float), [(9.5,)])
+    out = t1.update_rows(t2)
+    from pathway_tpu.debug import _capture
+
+    cap = _capture(out)
+    assert sorted(v[0] for v in cap.rows.values()) == [2.5, 9.5]
