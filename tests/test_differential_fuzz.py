@@ -1,0 +1,114 @@
+"""Seeded differential fuzz: random pipelines fed as one static load and as a
+multi-timestamp stream (with retractions) must agree — the engine-wide
+invariant behind the columnar incremental paths (streamed deltas take the
+same kernels as first loads). Reference analogue: differential dataflow's
+property that timestamp granularity never changes the consolidated output."""
+
+import numpy as np
+import pytest
+
+import pathway_tpu as pw
+
+from utils import rows_of
+
+
+def _make_rows(rng, n):
+    """(k, v) rows plus retractions of ~20% of earlier rows. Values are unique
+    per event: engine rows are keyed, so each logical row must be distinct
+    (markdown streams derive keys from values)."""
+    ks = rng.integers(0, max(n // 4, 2), n).tolist()
+    vs = (rng.integers(0, 50, n) * n + np.arange(n)).tolist()  # unique
+    events = [(k, v, 1) for k, v in zip(ks, vs)]
+    n_retract = n // 5
+    for i in rng.choice(n, size=n_retract, replace=False).tolist():
+        events.append((ks[i], vs[i], -1))
+    return events
+
+
+def _tables(events, right_rows, streamed, n_times):
+    if streamed:
+        lines = ["k | v | __time__ | __diff__"]
+        per = max(1, (len(events) + n_times - 1) // n_times)
+        for i, (k, v, d) in enumerate(events):
+            t = 2 * (i // per) + (2 if d < 0 else 0)  # retractions land later
+            lines.append(f"{k} | {v} | {t} | {d}")
+    else:
+        # the TRUE static path (no __time__ column -> table_from_static_data):
+        # net the events; only rows with net positive multiplicity survive
+        from collections import Counter
+
+        net = Counter()
+        for k, v, d in events:
+            net[(k, v)] += d
+        lines = ["k | v"]
+        for (k, v), m in net.items():
+            for _ in range(max(m, 0)):
+                lines.append(f"{k} | {v}")
+    left = pw.debug.table_from_markdown("\n".join(lines))
+    right = pw.debug.table_from_rows(
+        pw.schema_from_types(k=int, w=int), right_rows
+    )
+    return left, right
+
+
+def _pipeline(left, right, shape):
+    if shape == 0:
+        f = left.filter(left.v > 1000)
+        j = f.join(right, f.k == right.k).select(k=f.k, v=f.v, w=right.w)
+        return j.groupby(j.k).reduce(
+            j.k, s=pw.reducers.sum(j.v * j.w), c=pw.reducers.count()
+        )
+    if shape == 1:
+        j = left.join_left(right, left.k == right.k).select(
+            k=left.k, v=left.v, w=right.w
+        )
+        return j.groupby(j.w).reduce(w=j.w, s=pw.reducers.sum(j.v))
+    if shape == 2:
+        g = left.groupby(left.k).reduce(
+            k=left.k, mx=pw.reducers.max(left.v), s=pw.reducers.sum(left.v)
+        )
+        return g.filter(g.s > 2000)
+    j = left.join_outer(right, left.k == right.k).select(
+        k=pw.coalesce(left.k, right.k), v=left.v, w=right.w
+    )
+    return j.groupby(j.k).reduce(j.k, c=pw.reducers.count())
+
+
+def _keyed_rows(table, **run_kwargs):
+    from pathway_tpu.debug import _capture
+    from utils import _norm
+
+    cap = _capture(table, **run_kwargs)
+    return {k: tuple(_norm(v) for v in row) for k, row in cap.rows.items()}
+
+
+@pytest.mark.parametrize("shape", range(4))
+@pytest.mark.parametrize("seed", range(3))
+def test_streamed_equals_static(seed, shape):
+    rng = np.random.default_rng(seed * 10 + shape)
+    events = _make_rows(rng, 120)
+    right_rows = [
+        (int(k), int(w))
+        for k, w in zip(rng.integers(0, 30, 25), rng.integers(1, 9, 25))
+    ]
+    left_s, right_s = _tables(events, right_rows, streamed=True, n_times=7)
+    streamed = rows_of(_pipeline(left_s, right_s, shape))
+    left_b, right_b = _tables(events, right_rows, streamed=False, n_times=1)
+    static = rows_of(_pipeline(left_b, right_b, shape))
+    assert streamed == static, (shape, streamed, static)
+
+
+@pytest.mark.parametrize("n_workers", [1, 4])
+@pytest.mark.parametrize("shape", range(4))
+def test_streamed_equals_static_across_workers(shape, n_workers):
+    rng = np.random.default_rng(100 + shape)
+    events = _make_rows(rng, 100)
+    right_rows = [
+        (int(k), int(w))
+        for k, w in zip(rng.integers(0, 25, 20), rng.integers(1, 9, 20))
+    ]
+    left_s, right_s = _tables(events, right_rows, streamed=True, n_times=5)
+    streamed = _keyed_rows(_pipeline(left_s, right_s, shape), n_workers=n_workers)
+    left_b, right_b = _tables(events, right_rows, streamed=False, n_times=1)
+    static = _keyed_rows(_pipeline(left_b, right_b, shape), n_workers=1)
+    assert streamed == static
