@@ -18,6 +18,7 @@ from typing import Callable
 import numpy as np
 
 from pathway_tpu.engine.blocks import DeltaBatch, concat_batches
+from pathway_tpu.internals.trace import run_annotated as _run_annotated
 
 END_OF_STREAM = np.iinfo(np.int64).max  # frontier value after all input closed
 
@@ -151,7 +152,7 @@ class Scheduler:
             inputs = node.drain()
             node.stats_rows_in += sum(len(b) for b in inputs if b is not None)
             t0 = _time.perf_counter_ns()
-            out = node.process(inputs, time)
+            out = _run_annotated(node, node.process, inputs, time)
             node.stats_time_ns += _time.perf_counter_ns() - t0
             self._route(node, out)
             any_work = True
@@ -162,7 +163,7 @@ class Scheduler:
         advance the frontier past it."""
         self.current_time = time
         for node in self.graph.nodes:
-            self._route(node, node.poll(time))
+            self._route(node, _run_annotated(node, node.poll, time))
         while self._sweep(time):
             pass
         # frontier phase: notify in topo order; emissions re-enter the same tick
@@ -170,7 +171,7 @@ class Scheduler:
         while progressed:
             progressed = False
             for node in self.graph.nodes:
-                out = node.on_frontier(time)
+                out = _run_annotated(node, node.on_frontier, time)
                 if self._route(node, out):
                     progressed = True
             if progressed:

@@ -30,6 +30,10 @@ class LogicalNode:
         self.name = name
         self.runtime_hook = runtime_hook
         self.node_id: int = -1
+        # user code provenance for error annotation (reference trace_user_frame)
+        from pathway_tpu.internals.trace import user_frame
+
+        self.user_trace = user_frame()
         G.register(self)
 
     def __repr__(self) -> str:
@@ -54,6 +58,8 @@ class BuildContext:
             return node
         engine_inputs = [self.resolve(i) for i in lnode.inputs]
         node = lnode.factory()
+        node.user_trace = lnode.user_trace
+        node.logical_name = lnode.name
         node.name = lnode.name
         self.graph.add_node(node, engine_inputs)
         self.built[id(lnode)] = node

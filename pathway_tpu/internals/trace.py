@@ -1,0 +1,57 @@
+"""User stack-frame capture for diagnostics (reference: ``internals/trace.py``
+``trace_user_frame``): every logical operator remembers the user code line
+that created it, and engine failures annotate the raised exception with that
+provenance — so a traceback deep in the block kernels still says which
+``select``/``join``/``reduce`` in the user's pipeline it belongs to."""
+
+from __future__ import annotations
+
+import os
+import sys
+
+_PKG_DIR = os.path.dirname(os.path.dirname(os.path.abspath(__file__))) + os.sep
+
+
+def user_frame() -> tuple[str, int, str] | None:
+    """(filename, lineno, code line description) of the nearest caller frame
+    outside the pathway_tpu package."""
+    f = sys._getframe(1)
+    while f is not None:
+        fn = f.f_code.co_filename
+        if not fn.startswith(_PKG_DIR) and "importlib" not in fn:
+            return (fn, f.f_lineno, f.f_code.co_name)
+        f = f.f_back
+    return None
+
+
+def format_frame(frame: tuple[str, int, str] | None) -> str | None:
+    if frame is None:
+        return None
+    fn, line, func = frame
+    return f"{fn}:{line} in {func}"
+
+
+def annotate(exc: BaseException, op_name: str, frame: tuple[str, int, str] | None) -> None:
+    """Attach operator provenance to an in-flight exception (PEP 678 note)."""
+    where = format_frame(frame)
+    note = f"while running operator {op_name!r}"
+    if where:
+        note += f" created at {where}"
+    try:
+        exc.add_note(note)
+    except AttributeError:  # pre-3.11 safety
+        pass
+
+
+def run_annotated(node, method, *args):
+    """Call an engine-node method, annotating any exception with the node's
+    user provenance — the ONE wrapper every runtime shares."""
+    try:
+        return method(*args)
+    except Exception as e:
+        annotate(
+            e,
+            getattr(node, "logical_name", node.name),
+            getattr(node, "user_trace", None),
+        )
+        raise

@@ -406,7 +406,9 @@ class ClusterRuntime:
                     continue
                 inputs = node.drain()
             node.stats_rows_in += sum(len(b) for b in inputs if b is not None)
-            out = node.process(inputs, time)
+            from pathway_tpu.internals.trace import run_annotated
+
+            out = run_annotated(node, node.process, inputs, time)
             self._route(lw, node, out)
             any_work = True
         return any_work

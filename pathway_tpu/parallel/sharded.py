@@ -143,7 +143,9 @@ class ShardedRuntime:
                     continue
                 inputs = node.drain()
             node.stats_rows_in += sum(len(b) for b in inputs if b is not None)
-            out = node.process(inputs, time)
+            from pathway_tpu.internals.trace import run_annotated
+
+            out = run_annotated(node, node.process, inputs, time)
             if self._route(worker, node, out):
                 any_work = True
             any_work = any_work or any(b is not None for b in inputs)
@@ -189,9 +191,12 @@ class ShardedRuntime:
         progressed = True
         while progressed:
             progressed = False
+            from pathway_tpu.internals.trace import run_annotated
+
             for w in self.workers:
                 for node in w.graph.nodes:
-                    if self._route(w, node, node.on_frontier(time)):
+                    out = run_annotated(node, node.on_frontier, time)
+                    if self._route(w, node, out):
                         progressed = True
             if progressed:
                 while any(self._parallel(lambda w: self._sweep_worker(w, time))):

@@ -10,6 +10,7 @@ from pathway_tpu.stdlib import (
     statistical,
     temporal,
     utils,
+    viz,
 )
 
 __all__ = [
@@ -21,4 +22,5 @@ __all__ = [
     "statistical",
     "temporal",
     "utils",
+    "viz",
 ]

@@ -133,3 +133,15 @@ def test_operator_persisting_refused_on_sharded():
                 persistence_mode="operator_persisting",
             ),
         )
+
+
+def test_error_carries_user_provenance():
+    """Engine failures must name the user's pipeline line (trace.py parity)."""
+    G.clear()
+    t = pw.debug.table_from_rows(pw.schema_from_types(x=int), [(0,)])
+    bad = t.select(y=pw.apply(lambda v: 1 // int(v), t.x))  # PROVENANCE LINE
+    pw.io.subscribe(bad, on_change=lambda **k: None)
+    with pytest.raises(EngineErrorWithTrace) as ei:
+        pw.run(monitoring_level="none")
+    notes = getattr(ei.value, "__notes__", [])
+    assert any("test_errors.py" in n and "select" in n for n in notes), notes
