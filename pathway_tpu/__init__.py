@@ -120,6 +120,15 @@ from pathway_tpu.internals.interactive import (  # noqa: E402
     enable_interactive_mode,
     live,
 )
+from pathway_tpu.internals.row_transformer import (  # noqa: E402
+    ClassArg,
+    attribute,
+    input_attribute,
+    input_method,
+    method,
+    output_attribute,
+    transformer,
+)
 
 
 def set_license_key(key: str | None) -> None:
@@ -184,6 +193,13 @@ __all__ = [
     "enable_interactive_mode",
     "live",
     "pandas_transformer",
+    "ClassArg",
+    "attribute",
+    "input_attribute",
+    "input_method",
+    "method",
+    "output_attribute",
+    "transformer",
     "temporal",
     "indexing",
     "universes",

@@ -13,6 +13,7 @@ from typing import Any, Callable
 
 from pathway_tpu.engine.graph import EngineGraph, Node
 from pathway_tpu.internals.parse_graph import G
+from pathway_tpu.internals.trace import user_frame as _user_frame
 
 
 class LogicalNode:
@@ -31,9 +32,7 @@ class LogicalNode:
         self.runtime_hook = runtime_hook
         self.node_id: int = -1
         # user code provenance for error annotation (reference trace_user_frame)
-        from pathway_tpu.internals.trace import user_frame
-
-        self.user_trace = user_frame()
+        self.user_trace = _user_frame()
         G.register(self)
 
     def __repr__(self) -> str:
@@ -59,7 +58,6 @@ class BuildContext:
         engine_inputs = [self.resolve(i) for i in lnode.inputs]
         node = lnode.factory()
         node.user_trace = lnode.user_trace
-        node.logical_name = lnode.name
         node.name = lnode.name
         self.graph.add_node(node, engine_inputs)
         self.built[id(lnode)] = node

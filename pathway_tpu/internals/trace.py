@@ -49,9 +49,5 @@ def run_annotated(node, method, *args):
     try:
         return method(*args)
     except Exception as e:
-        annotate(
-            e,
-            getattr(node, "logical_name", node.name),
-            getattr(node, "user_trace", None),
-        )
+        annotate(e, node.name, getattr(node, "user_trace", None))
         raise

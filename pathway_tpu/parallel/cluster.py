@@ -39,6 +39,7 @@ from pathway_tpu.engine.blocks import DeltaBatch
 from pathway_tpu.engine.graph import BROADCAST, END_OF_STREAM, SOLO, Node
 from pathway_tpu.internals.config import get_pathway_config
 from pathway_tpu.internals.logical import BuildContext, LogicalNode
+from pathway_tpu.internals.trace import run_annotated
 from pathway_tpu.parallel.mesh import shard_of_keys
 
 
@@ -406,8 +407,6 @@ class ClusterRuntime:
                     continue
                 inputs = node.drain()
             node.stats_rows_in += sum(len(b) for b in inputs if b is not None)
-            from pathway_tpu.internals.trace import run_annotated
-
             out = run_annotated(node, node.process, inputs, time)
             self._route(lw, node, out)
             any_work = True
@@ -474,13 +473,13 @@ class ClusterRuntime:
         if 0 in self.local_workers:
             lw0 = self.local_workers[0]
             for node in lw0.graph.nodes:
-                self._route(lw0, node, node.poll(time))
+                self._route(lw0, node, run_annotated(node, node.poll, time))
         self._round_until_quiescent(time, "sweep")
         while True:
             progressed = False
             for lw in self.local_workers.values():
                 for node in lw.graph.nodes:
-                    if self._route(lw, node, node.on_frontier(time)):
+                    if self._route(lw, node, run_annotated(node, node.on_frontier, time)):
                         progressed = True
 
             def decide(reports):

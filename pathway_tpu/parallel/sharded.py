@@ -33,6 +33,7 @@ import numpy as np
 from pathway_tpu.engine.blocks import DeltaBatch
 from pathway_tpu.engine.graph import BROADCAST, END_OF_STREAM, SOLO, EngineGraph, Node
 from pathway_tpu.internals.logical import BuildContext, LogicalNode
+from pathway_tpu.internals.trace import run_annotated
 from pathway_tpu.parallel.mesh import shard_of_keys
 
 
@@ -143,8 +144,6 @@ class ShardedRuntime:
                     continue
                 inputs = node.drain()
             node.stats_rows_in += sum(len(b) for b in inputs if b is not None)
-            from pathway_tpu.internals.trace import run_annotated
-
             out = run_annotated(node, node.process, inputs, time)
             if self._route(worker, node, out):
                 any_work = True
@@ -185,14 +184,12 @@ class ShardedRuntime:
         # (polling them would duplicate every input row per worker)
         w0 = self.workers[0]
         for node in w0.graph.nodes:
-            self._route(w0, node, node.poll(time))
+            self._route(w0, node, run_annotated(node, node.poll, time))
         while any(self._parallel(lambda w: self._sweep_worker(w, time))):
             pass
         progressed = True
         while progressed:
             progressed = False
-            from pathway_tpu.internals.trace import run_annotated
-
             for w in self.workers:
                 for node in w.graph.nodes:
                     out = run_annotated(node, node.on_frontier, time)

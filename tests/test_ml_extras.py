@@ -178,3 +178,144 @@ def test_knn_index_with_distances():
     assert len(dists) == 2 and dists[0] <= dists[1]
     with pytest.raises(NotImplementedError, match="metadata"):
         index.get_nearest_items(queries.emb, metadata_filter="x")
+
+
+# ------------------------------------------------------ legacy row transformer
+
+
+def test_row_transformer_simple():
+    class OutputSchema(pw.Schema):
+        ret: int
+
+    @pw.transformer
+    class foo_transformer:
+        class table(pw.ClassArg, output=OutputSchema):
+            arg = pw.input_attribute()
+
+            @pw.output_attribute
+            def ret(self) -> int:
+                return self.arg + 1
+
+    t = pw.debug.table_from_rows(pw.schema_from_types(arg=int), [(1,), (2,), (3,)])
+    out = foo_transformer(t).table
+    assert sorted(rows_of(out).elements()) == [(2,), (3,), (4,)]
+
+
+def test_row_transformer_cross_table_traversal():
+    """The reference's list-traversal shape: requests walk a linked list held
+    in another table via self.transformer.<table>[pointer]."""
+
+    @pw.transformer
+    class list_traversal:
+        class nodes(pw.ClassArg):
+            next = pw.input_attribute()
+            val = pw.input_attribute()
+
+        class requests(pw.ClassArg):
+            node = pw.input_attribute()
+            steps = pw.input_attribute()
+
+            @pw.output_attribute
+            def reached_value(self) -> int:
+                node = self.transformer.nodes[self.node]
+                for _ in range(self.steps):
+                    node = self.transformer.nodes[node.next]
+                return node.val
+
+    nodes = pw.debug.table_from_rows(
+        pw.schema_from_types(name=int, nxt=int, val=int),
+        [(1, 2, 11), (2, 3, 12), (3, 3, 13)],
+    ).with_id_from(pw.this.name)
+    nodes = nodes.select(
+        next=nodes.pointer_from(nodes.nxt), val=nodes.val
+    )
+    requests = pw.debug.table_from_rows(
+        pw.schema_from_types(node=int, steps=int), [(1, 1), (3, 0)]
+    )
+    requests = requests.select(
+        node=nodes.pointer_from(requests.node), steps=requests.steps
+    )
+    out = list_traversal(nodes, requests).requests
+    assert sorted(rows_of(out).elements()) == [(12,), (13,)]
+
+
+def test_row_transformer_memoized_recursion():
+    """fib-style self-recursion through pointers must memoize, and cycles must
+    be detected rather than hanging."""
+
+    @pw.transformer
+    class fib_transformer:
+        class cells(pw.ClassArg):
+            prev = pw.input_attribute()
+            prev2 = pw.input_attribute()
+            base = pw.input_attribute()
+
+            @pw.output_attribute
+            def value(self) -> int:
+                if self.base is not None:
+                    return self.base
+                return (
+                    self.transformer.cells[self.prev].value
+                    + self.transformer.cells[self.prev2].value
+                )
+
+    n = 12
+    rows = []
+    for i in range(n):
+        rows.append((i, max(i - 1, 0), max(i - 2, 0), 1 if i < 2 else None))
+    from typing import Optional
+
+    cells = pw.debug.table_from_rows(
+        pw.schema_from_types(idx=int, p1=int, p2=int, base=Optional[int]), rows
+    ).with_id_from(pw.this.idx)
+    cells = cells.select(
+        prev=cells.pointer_from(cells.p1),
+        prev2=cells.pointer_from(cells.p2),
+        base=cells.base,
+    )
+    out = fib_transformer(cells).cells
+    values = sorted(v for (v,) in rows_of(out).elements())
+    assert max(values) == 144  # fib(12)
+
+
+def test_row_transformer_empty_sibling_table():
+    """One empty input must not empty the other outputs."""
+
+    @pw.transformer
+    class two_tables:
+        class a(pw.ClassArg):
+            x = pw.input_attribute()
+
+            @pw.output_attribute
+            def out(self):
+                return self.x
+
+        class b(pw.ClassArg):
+            y = pw.input_attribute()
+
+            @pw.output_attribute
+            def dbl(self):
+                return self.y * 2
+
+    empty = pw.debug.table_from_rows(pw.schema_from_types(x=int), [])
+    full = pw.debug.table_from_rows(pw.schema_from_types(y=int), [(5,), (7,)])
+    res = two_tables(empty, full)
+    assert sorted(rows_of(res.b).elements()) == [(10,), (14,)]
+    assert len(rows_of(res.a)) == 0
+
+
+def test_row_transformer_rejects_extra_tables():
+    @pw.transformer
+    class one_table:
+        class t(pw.ClassArg):
+            x = pw.input_attribute()
+
+            @pw.output_attribute
+            def out(self):
+                return self.x
+
+    t1 = pw.debug.table_from_rows(pw.schema_from_types(x=int), [(1,)])
+    with pytest.raises(TypeError, match="takes 1 tables"):
+        one_table(t1, t1)
+    with pytest.raises(TypeError, match="passed twice"):
+        one_table(t1, t=t1)
