@@ -26,16 +26,24 @@ def is_interactive_mode_enabled() -> bool:
 class InteractiveRunHandle:
     """Returned by ``pw.run()`` in interactive mode."""
 
-    def __init__(self, runtime, thread: threading.Thread):
+    def __init__(self, runtime, thread: threading.Thread, on_finish=None):
         self._runtime = runtime
         self._thread = thread
+        self._on_finish = on_finish
+
+    def _finish(self) -> None:
+        if self._on_finish is not None and not self._thread.is_alive():
+            cb, self._on_finish = self._on_finish, None
+            cb()
 
     def stop(self, timeout: float = 10.0) -> None:
         self._runtime.request_stop()
         self._thread.join(timeout)
+        self._finish()
 
     def join(self, timeout: float | None = None) -> None:
         self._thread.join(timeout)
+        self._finish()
 
     @property
     def alive(self) -> bool:

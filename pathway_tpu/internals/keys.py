@@ -90,12 +90,15 @@ def _splitmix64_int(x: int) -> int:
 
 _NONE_SEED = 0xA5C9
 
-# Deployment-stable salt for object-key hashing. pwhash64 is a fast NON-
+# Deployment-stable salt for key hashing. pwhash64 is a fast NON-
 # CRYPTOGRAPHIC hash (like the reference engine's key hashing): with the
 # default salt an adversary who fully controls input keys can engineer
 # collisions. Deployments ingesting untrusted keys can set PATHWAY_HASH_SALT
 # to make the chain unpredictable; it must be identical on every process of a
-# cluster and across restarts of a persisted pipeline.
+# cluster and across restarts of a persisted pipeline. The salt covers every
+# value-derived path — str/bytes (seed), int/float/bool/datetime (pre-mix
+# xor, scalar AND vectorized), None, and the blake2b fallback (keyed) — and
+# is a no-op when unset, so default-salt hashes are unchanged.
 import os as _os
 
 _HASH_SALT = (
@@ -103,6 +106,14 @@ _HASH_SALT = (
     if "PATHWAY_HASH_SALT" in _os.environ
     else 0
 )
+_SALT_U64 = np.uint64(_HASH_SALT)
+_SALT_KEY = _HASH_SALT.to_bytes(8, "little") if _HASH_SALT else b""
+
+
+def _salted(bits: np.ndarray) -> np.ndarray:
+    """XOR the salt into a uint64 array — identity (no extra array pass on the
+    hot per-tick hashing path) when no salt is configured."""
+    return bits ^ _SALT_U64 if _HASH_SALT else bits
 
 
 def _pwhash_bytes(b: bytes, tag: int) -> int:
@@ -126,25 +137,27 @@ def stable_hash_obj(v: Any) -> np.uint64:
     if v is None:
         # double-mixed so the colliding integer pre-image is a pseudo-random
         # 64-bit value, not the small literal 0xA5C9
-        return np.uint64(_splitmix64_int(_splitmix64_int(_NONE_SEED)))
+        return np.uint64(_splitmix64_int(_splitmix64_int(_NONE_SEED ^ _HASH_SALT)))
     # datetime64/timedelta64 must precede the integer branch: timedelta64
     # subclasses np.signedinteger, and int() of a non-ns timedelta64 raises
     if isinstance(v, np.datetime64):
         ns = int(v.astype("datetime64[ns]").astype(np.int64))
-        return np.uint64(_splitmix64_int(ns & _U64_MASK))
+        return np.uint64(_splitmix64_int((ns ^ _HASH_SALT) & _U64_MASK))
     if isinstance(v, np.timedelta64):
         ns = int(v.astype("timedelta64[ns]").astype(np.int64))
-        return np.uint64(_splitmix64_int(ns & _U64_MASK))
+        return np.uint64(_splitmix64_int((ns ^ _HASH_SALT) & _U64_MASK))
     if isinstance(v, (bool, np.bool_, int, np.integer)):
-        return np.uint64(_splitmix64_int(int(v) & _U64_MASK))
+        return np.uint64(_splitmix64_int((int(v) ^ _HASH_SALT) & _U64_MASK))
     if isinstance(v, (float, np.floating)):
         f = np.float64(v) + 0.0  # normalize -0.0
-        return np.uint64(_splitmix64_int(int(f.view(np.uint64))))
+        return np.uint64(_splitmix64_int(int(f.view(np.uint64)) ^ _HASH_SALT))
     if isinstance(v, str):
         return np.uint64(_pwhash_bytes(v.encode("utf-8"), 0x04))
     if isinstance(v, bytes):
         return np.uint64(_pwhash_bytes(v, 0x05))
-    digest = hashlib.blake2b(_canonical_bytes(v), digest_size=8).digest()
+    digest = hashlib.blake2b(
+        _canonical_bytes(v), digest_size=8, key=_SALT_KEY
+    ).digest()
     return np.uint64(int.from_bytes(digest, "little"))
 
 
@@ -163,27 +176,28 @@ def hash_column(col: np.ndarray) -> np.ndarray:
     """Vectorized stable hash of one column → uint64 array."""
     kind = col.dtype.kind
     if kind in ("i", "u", "b"):
-        return splitmix64(col.astype(np.uint64, copy=False))
+        return splitmix64(_salted(col.astype(np.uint64, copy=False)))
     if kind == "f":
         # normalize -0.0 → 0.0 so equal floats hash equal
         c = col + 0.0
-        return splitmix64(c.view(np.uint64) if c.dtype == np.float64 else c.astype(np.float64).view(np.uint64))
+        bits = c.view(np.uint64) if c.dtype == np.float64 else c.astype(np.float64).view(np.uint64)
+        return splitmix64(_salted(bits))
     if kind == "M":
         # normalize to ns so equal instants in different units hash equal (and
         # match stable_hash_obj / _canonical_bytes)
-        return splitmix64(col.astype("datetime64[ns]").astype(np.int64).astype(np.uint64))
+        return splitmix64(_salted(col.astype("datetime64[ns]").astype(np.int64).astype(np.uint64)))
     if kind == "m":
-        return splitmix64(col.astype("timedelta64[ns]").astype(np.int64).astype(np.uint64))
+        return splitmix64(_salted(col.astype("timedelta64[ns]").astype(np.int64).astype(np.uint64)))
     if kind == "O" and len(col) > 16:
         # homogeneous-scalar fast path: coerce to a typed array and take the
         # vectorized branch (they hash identically by construction)
         types = {type(v) for v in col}
         try:
             if types and all(issubclass(t, _INT_TYPES) for t in types):
-                return splitmix64(col.astype(np.int64).astype(np.uint64))
+                return splitmix64(_salted(col.astype(np.int64).astype(np.uint64)))
             if types and all(issubclass(t, _FLOAT_TYPES) for t in types):
                 c = col.astype(np.float64) + 0.0
-                return splitmix64(c.view(np.uint64))
+                return splitmix64(_salted(c.view(np.uint64)))
         except (TypeError, ValueError, OverflowError):
             pass
     if _pwhash_native is not None:
@@ -209,6 +223,22 @@ def ref_scalar(*values: Any, salt: int = 0) -> np.uint64:
         return splitmix64(np.asarray([salt], dtype=np.uint64))[0]
     cols = [np.asarray([v]) if not isinstance(v, str) else np.asarray([v], dtype=object) for v in values]
     return row_keys(cols, salt=salt)[0]
+
+
+def tie_order(key: Any) -> int:
+    """Canonical total order on doc keys for score-tie breaking, shared by the
+    KNN kernels, host-side decode, BM25/hybrid ranking, and the sharded-index
+    reply merge. Hash order (not numeric order): uniform high bits for EVERY
+    key type, so the device kernels' 30-bit composite tie-break is a true
+    prefix of this order even for small integer keys (numeric order has empty
+    top bits there and would degrade to slot order on device)."""
+    return int(stable_hash_obj(key))
+
+
+def tie_order_u64(keys: np.ndarray) -> np.ndarray:
+    """Vectorized :func:`tie_order` for uint64/int key arrays (bit-identical
+    to ``stable_hash_obj`` on python ints)."""
+    return splitmix64(_salted(keys.astype(np.uint64)))
 
 
 def combine_keys(a: np.ndarray, b: np.ndarray) -> np.ndarray:

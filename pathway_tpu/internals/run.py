@@ -73,8 +73,11 @@ def run(
     terminate_on_error: bool | None = None,
     n_workers: int | None = None,
     **kwargs: Any,
-) -> None:
-    """Execute every output (sink/subscribe/debug) registered so far."""
+) -> "InteractiveRunHandle | None":
+    """Execute every output (sink/subscribe/debug) registered so far.
+
+    Returns ``None``, except in interactive mode where the run continues on a
+    daemon thread and an ``InteractiveRunHandle`` is returned."""
     global _last_runtime
     if not G.outputs:
         import warnings
@@ -133,15 +136,23 @@ def run(
             try:
                 runtime.run(outputs)
             finally:
-                # NOTE: the error policy deliberately stays as configured —
-                # restoring a process-global from a daemon thread would race
-                # with any later pw.run on the main thread
+                # the error policy is NOT restored here (restoring a
+                # process-global from a daemon thread would race a later
+                # pw.run on the main thread) — the handle restores it from
+                # stop()/join(), i.e. on the thread that owns the policy
                 if http_server is not None:
                     http_server.stop()
 
         th = _threading.Thread(target=_bg, daemon=True)
         th.start()
-        return _interactive.InteractiveRunHandle(runtime, th)
+
+        def _restore():
+            # restore only if the policy is still the one THIS run set —
+            # a later pw.run (or another handle) may own the global by now
+            if _errors.get_error_policy() == terminate_on_error:
+                _errors.set_error_policy(prev_policy)
+
+        return _interactive.InteractiveRunHandle(runtime, th, on_finish=_restore)
 
     try:
         runtime.run(list(G.outputs))

@@ -47,32 +47,34 @@ static uint64_t pwhash_bytes(const unsigned char *p, Py_ssize_t n, uint64_t tag,
 #define NONE_SEED 0xA5C9ULL
 
 static int hash_one(PyObject *v, PyObject *fallback, uint64_t salt, uint64_t *out) {
+    /* salt is xor-ed into every pre-mix value (no-op when 0), mirroring
+     * internals/keys.py stable_hash_obj exactly */
     if (v == Py_None) {
-        *out = splitmix64(splitmix64(NONE_SEED));
+        *out = splitmix64(splitmix64(NONE_SEED ^ salt));
         return 0;
     }
     if (PyBool_Check(v)) {
-        *out = splitmix64(v == Py_True ? 1 : 0);
+        *out = splitmix64((v == Py_True ? 1 : 0) ^ salt);
         return 0;
     }
     if (PyLong_Check(v)) {
         int overflow = 0;
         long long x = PyLong_AsLongLongAndOverflow(v, &overflow);
         if (!overflow && !(x == -1 && PyErr_Occurred())) {
-            *out = splitmix64((uint64_t)x);
+            *out = splitmix64((uint64_t)x ^ salt);
             return 0;
         }
         PyErr_Clear();
         unsigned long long ux = PyLong_AsUnsignedLongLongMask(v);
         PyErr_Clear();
-        *out = splitmix64((uint64_t)ux);
+        *out = splitmix64((uint64_t)ux ^ salt);
         return 0;
     }
     if (PyFloat_Check(v)) {
         double d = PyFloat_AS_DOUBLE(v) + 0.0; /* normalize -0.0 */
         uint64_t bits;
         memcpy(&bits, &d, 8);
-        *out = splitmix64(bits);
+        *out = splitmix64(bits ^ salt);
         return 0;
     }
     if (PyUnicode_Check(v)) {

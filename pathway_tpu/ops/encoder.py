@@ -27,6 +27,11 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from pathway_tpu.native import try_load as _try_load_native
+
+# C tokenizer kernel (None -> pure-Python fallback, bit-identical)
+_pwtok_native = _try_load_native("pwtok")
+
 
 class EncoderConfig(NamedTuple):
     vocab_size: int = 32768
@@ -229,6 +234,16 @@ def encode_jit(params: dict, cfg: EncoderConfig, token_ids: jax.Array, mask: jax
     return encode(params, cfg, token_ids, mask)
 
 
+@partial(jax.jit, static_argnames=("cfg",))
+def encode_ids_jit(params: dict, cfg: EncoderConfig, token_ids: jax.Array):
+    """ids-only forward: the mask is recovered on device as ``ids != 0``
+    (tokenizer contract: pad id is 0 and no real token maps to 0), and narrow
+    int dtypes (int16 from the hash tokenizer) widen on device — so the
+    host→device transfer is a single small integer array."""
+    mask = token_ids != 0
+    return encode(params, cfg, token_ids.astype(jnp.int32), mask)
+
+
 def contrastive_loss(params, cfg, tok_a, mask_a, tok_b, mask_b, temperature=0.05):
     """Symmetric InfoNCE over in-batch negatives (f32 logits)."""
     za = encode(params, cfg, tok_a, mask_a)
@@ -254,7 +269,17 @@ def contrastive_train_step(params, cfg, opt_state, batch, lr=1e-4):
 class HashTokenizer:
     """Deterministic hashing tokenizer: whitespace+punct split, token → bucket via
     stable hash. No external vocab files; good enough for indexing/recall pipelines
-    and fully reproducible across hosts (SURVEY §7.3 byte-identical answers)."""
+    and fully reproducible across hosts (SURVEY §7.3 byte-identical answers).
+
+    The per-doc loop runs in C when the toolchain is available
+    (``native/pwtok.c``, bit-identical mirror of ``_tok`` for ASCII text) —
+    pure-Python per-word hashing was the round-3 ingest bottleneck.
+    Emits int16 ids when the vocab fits (halves the host→device transfer);
+    id 0 is reserved for padding, so ``ids != 0`` recovers the mask on device.
+    """
+
+    #: id 0 is reserved for padding by construction (real ids are >= 1)
+    pad_id_zero = True
 
     def __init__(self, vocab_size: int = 32768, max_len: int = 128):
         self.vocab_size = vocab_size
@@ -272,19 +297,42 @@ class HashTokenizer:
             out.append(3 + h % (self.vocab_size - 3))  # 0=pad, 1=cls, 2=sep
         return out
 
+    def _tok_batch(self, texts: list[str]) -> tuple[np.ndarray, np.ndarray]:
+        """(word_ids [N, max_len] int32, lens [N]) via the C kernel with a
+        Python fallback for non-ASCII rows (and for a missing compiler)."""
+        if _pwtok_native is not None:
+            arr = np.empty(len(texts), dtype=object)
+            arr[:] = texts
+            cids, lens = _pwtok_native.hash_tokenize(arr, self.vocab_size, self.max_len)
+            fallback = np.nonzero(lens < 0)[0]
+            for i in fallback:
+                t = self._tok(texts[i])
+                lens[i] = len(t)
+                cids[i, : len(t)] = t
+            return cids, lens
+        cids = np.zeros((len(texts), self.max_len), dtype=np.int32)
+        lens = np.zeros(len(texts), dtype=np.int32)
+        for i, text in enumerate(texts):
+            t = self._tok(text)
+            lens[i] = len(t)
+            cids[i, : len(t)] = t
+        return cids, lens
+
     def __call__(self, texts: list[str]) -> tuple[np.ndarray, np.ndarray]:
-        toks = [[1] + self._tok(t) for t in texts]
         # pad sequence length to a power-of-two bucket so jitted callers see a small
         # closed set of shapes (compile-cache discipline, ops/microbatch.py)
         from pathway_tpu.ops.microbatch import bucket_size
 
-        L = min(self.max_len, bucket_size(max((len(t) for t in toks), default=1), min_bucket=16))
-        ids = np.zeros((len(toks), L), dtype=np.int32)
-        mask = np.zeros((len(toks), L), dtype=bool)
-        for i, t in enumerate(toks):
-            t = t[:L]
-            ids[i, : len(t)] = t
-            mask[i, : len(t)] = True
+        cids, lens = self._tok_batch(texts)
+        L = min(self.max_len, bucket_size(int(lens.max(initial=0)) + 1, min_bucket=16))
+        n = len(texts)
+        dtype = np.int16 if self.vocab_size <= 32768 else np.int32
+        ids = np.zeros((n, L), dtype=dtype)
+        ids[:, 0] = 1  # [CLS]
+        keep = np.minimum(lens, L - 1)
+        body = np.arange(L - 1)[None, :] < keep[:, None]
+        ids[:, 1:] = np.where(body, cids[:, : L - 1], 0).astype(dtype)
+        mask = ids != 0
         return ids, mask
 
 
@@ -311,6 +359,9 @@ class WordPieceTokenizer:
         self.cls_id = vocab[cls_token]
         self.sep_id = vocab[sep_token]
         self.max_word_chars = max_word_chars
+        # ids-only device transfer is safe only if vocab slot 0 is the pad
+        # token (standard for BERT vocabs); otherwise the mask must ship
+        self.pad_id_zero = vocab.get("[PAD]", -1) == 0
 
     @classmethod
     def from_vocab_file(cls, path: str, **kwargs) -> "WordPieceTokenizer":
@@ -405,9 +456,17 @@ class JaxSentenceEncoder:
         mesh: Mesh | None = None,
         params: dict | None = None,
         tokenizer: Any = None,
+        param_dtype: Any = None,
     ):
         self.cfg = cfg or EncoderConfig()
         self.params = params if params is not None else init_params(self.cfg, jax.random.PRNGKey(seed))
+        if param_dtype is not None:
+            # store matrices in the compute dtype (bf16): halves HBM weight
+            # traffic and skips the per-call f32→bf16 casts; norms/biases stay f32
+            self.params = jax.tree.map(
+                lambda p: p.astype(param_dtype) if getattr(p, "ndim", 0) >= 2 else p,
+                self.params,
+            )
         self.tokenizer = tokenizer or HashTokenizer(self.cfg.vocab_size, self.cfg.max_len)
         if mesh is not None:
             self.params = jax.tree.map(
@@ -423,18 +482,29 @@ class JaxSentenceEncoder:
     def encode_texts(self, texts: list[str]) -> np.ndarray:
         if not texts:
             return np.zeros((0, self.cfg.d_model), dtype=np.float32)
-        ids, mask = self.tokenizer(texts)
-        return np.asarray(encode_jit(self.params, self.cfg, ids, mask))
+        return np.asarray(self.encode_texts_device(texts))
 
     def encode_texts_device(self, texts: list[str]) -> jax.Array:
         """Like ``encode_texts`` but returns the device array without syncing —
         chain into device-consuming ops (e.g. ``BruteForceKnnIndex.
-        add_batch_device``) to keep a whole ingest pipeline async."""
+        add_batch_device``) to keep a whole ingest pipeline async.
+
+        When the tokenizer declares ``pad_id_zero`` (pad id is 0 and no real
+        token maps to 0 — true for the hash tokenizer and for WordPiece vocabs
+        whose slot 0 is [PAD]), only the (narrow-int) id array crosses to the
+        device and the mask is re-derived there; otherwise the tokenizer's own
+        mask is honored and shipped alongside."""
         ids, mask = self.tokenizer(texts)
-        return encode_jit(self.params, self.cfg, ids, mask)
+        if getattr(self.tokenizer, "pad_id_zero", False):
+            return encode_ids_jit(self.params, self.cfg, ids)
+        return encode_jit(self.params, self.cfg, jnp.asarray(ids, jnp.int32), mask)
 
     def encode_tokens(self, ids: np.ndarray, mask: np.ndarray) -> np.ndarray:
         return np.asarray(encode_jit(self.params, self.cfg, ids, mask))
+
+    def encode_ids_device(self, ids: np.ndarray | jax.Array) -> jax.Array:
+        """Pre-tokenized ids (pad id 0) → embeddings, fully on device."""
+        return encode_ids_jit(self.params, self.cfg, ids)
 
     @classmethod
     def from_pretrained(

@@ -49,16 +49,21 @@ def _pad_to_capacity(n: int) -> int:
 
 
 def _key_bits_one(k: Any) -> int:
-    """Top 32 bits of a key (canonical tie-break, consistent with the
-    cross-shard merge's full-key ordering); non-int keys hash stably."""
-    if isinstance(k, (int, np.integer)):
-        return (int(k) & 0xFFFFFFFFFFFFFFFF) >> 32
-    from pathway_tpu.internals.keys import stable_hash_obj
+    """Top 32 bits of the key's canonical tie order (``keys.tie_order`` =
+    hash order) — a true order prefix for every key type, including small
+    ints whose raw top bits would all be zero."""
+    from pathway_tpu.internals.keys import tie_order
 
-    return int(stable_hash_obj(k)) >> 32
+    return tie_order(k) >> 32
 
 
 def _key_bits_of(keys: Sequence[Any]) -> np.ndarray:
+    arr = np.asarray(keys)
+    if arr.dtype.kind in ("i", "u", "b"):
+        # vectorized: tie_order_u64 is bit-identical to tie_order on ints
+        from pathway_tpu.internals.keys import tie_order_u64
+
+        return (tie_order_u64(arr) >> np.uint64(32)).astype(np.uint32)
     return np.fromiter((_key_bits_one(k) for k in keys), dtype=np.uint32, count=len(keys))
 
 
@@ -77,7 +82,13 @@ def _search_kernel(
     Ties break CANONICALLY by smaller key (via ``key_bits``), not by slot
     order — so a sharded index cuts each shard's local top-k with exactly the
     rule the cross-shard merge uses, and worker count cannot change which of
-    several equal-score documents survive the cut."""
+    several equal-score documents survive the cut.
+
+    Precision caveat: the composite keeps the top 30 bits of the top-32 key
+    bits (x64 is off, so the composite must fit int32). Equal-score candidates
+    whose keys collide in those 30 bits fall back to ``lax.top_k`` slot order —
+    the worker-count byte-identity guarantee is therefore probabilistic,
+    ~2^-30 per tied pair (keys are hashes, so bit collisions are uniform)."""
     dots = jnp.einsum(
         "qd,nd->qn", queries, vectors, preferred_element_type=jnp.float32
     )
@@ -98,19 +109,33 @@ def _search_kernel(
             jnp.zeros((q, 0), dtype=scores.dtype),
             jnp.zeros((q, 0), dtype=jnp.int32),
         )
-    # two passes, int32-safe (x64 stays off): pass 1 finds the k-th score per
-    # query; pass 2 takes everything strictly above it plus the smallest-key
-    # boundary ties — |above| < k always, so one top_k over the composite
-    # selects exactly the canonical set
+    return _canonical_select(scores, key_bits, k)
+
+
+def _canonical_select(
+    scores: jax.Array,    # [Q, C] f32, -inf = invalid
+    key_bits: jax.Array,  # [C] or [Q, C] uint32
+    k: int,
+) -> tuple[jax.Array, jax.Array]:
+    """Exact top-k under the canonical (score desc, key asc) order.
+
+    Two passes, int32-safe (x64 stays off): pass 1 finds the k-th score per
+    query; pass 2 takes everything strictly above it plus the smallest-key
+    boundary ties — |above| < k always, so one top_k over the composite
+    selects exactly the canonical set. Used by both the single-device kernel
+    and the cross-shard candidate merge, so shard count cannot change which
+    equal-score candidates survive."""
     top_scores0, _ = jax.lax.top_k(scores, k)
     thr = top_scores0[:, -1:]
     above = scores > thr
-    eq = (scores == thr) & valid[None, :]
+    eq = (scores == thr) & jnp.isfinite(scores)
     inv_key30 = (jnp.uint32(0x3FFFFFFF) - (key_bits >> 2)).astype(jnp.int32)
+    if inv_key30.ndim == 1:
+        inv_key30 = inv_key30[None, :]
     comp = jnp.where(
         above,
         jnp.int32(0x7FFFFFFF),
-        jnp.where(eq, inv_key30[None, :], jnp.int32(-1)),
+        jnp.where(eq, inv_key30, jnp.int32(-1)),
     )
     _c, top_ids = jax.lax.top_k(comp, k)
     top_scores = jnp.take_along_axis(scores, top_ids, axis=1)
@@ -118,11 +143,9 @@ def _search_kernel(
 
 
 def _key_order(key: Any):
-    if isinstance(key, (int, np.integer)):
-        return int(key)
-    from pathway_tpu.internals.keys import stable_hash_obj
+    from pathway_tpu.internals.keys import tie_order
 
-    return int(stable_hash_obj(key))
+    return tie_order(key)
 
 
 def _decode_hits(
@@ -149,6 +172,22 @@ def _decode_hits(
 def _update_slots(vectors: jax.Array, slots: jax.Array, rows: jax.Array) -> jax.Array:
     """Scatter rows[i] into vectors[slots[i]]. rows: [m, d], slots: [m]."""
     return vectors.at[slots].set(rows)
+
+
+@jax.jit
+def _pack_hits(scores: jax.Array, slot_ids: jax.Array) -> jax.Array:
+    """Pack (scores [Q,k] f32, ids [Q,k] i32) into one [Q, 2k] f32 array so
+    results cross the host boundary in a SINGLE fetch — under a remote/
+    tunneled chip every separate device→host read costs a full round trip
+    (~100 ms here), so this halves query latency. Ids are value-cast (exact
+    for ids < 2^24), NOT bitcast: small ints bitcast to f32 are denormals,
+    which the TPU flushes to zero."""
+    return jnp.concatenate([scores, slot_ids.astype(jnp.float32)], axis=1)
+
+
+def _unpack_hits(packed: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+    k = packed.shape[1] // 2
+    return packed[:, :k], packed[:, k:].astype(np.int64)
 
 
 @jax.jit
@@ -208,7 +247,15 @@ class BruteForceKnnIndex:
         self._vectors = jnp.asarray(d["_vectors"])
         self._norms_sq = jnp.asarray(d["_norms_sq"])
         self._valid = jnp.asarray(d["_valid"])
-        self._key_bits = jnp.asarray(d["_key_bits"])
+        # recompute tie-break bits from the keys instead of trusting the
+        # snapshot: a snapshot written under an older tie-order scheme (or a
+        # different PATHWAY_HASH_SALT) would otherwise leave device bits that
+        # disagree with the host-side canonical order
+        bits = np.zeros(len(d["_key_bits"]), dtype=np.uint32)
+        if self._slot_to_key:
+            slots = np.fromiter(self._slot_to_key, dtype=np.int64, count=len(self._slot_to_key))
+            bits[slots] = _key_bits_of(list(self._slot_to_key.values()))
+        self._key_bits = jnp.asarray(bits)
 
     # -- capacity ------------------------------------------------------------
     @property
@@ -383,14 +430,7 @@ class BruteForceKnnIndex:
             self._pending_device = []
 
     # -- search --------------------------------------------------------------
-    def search(
-        self, queries: np.ndarray, k: int
-    ) -> list[list[tuple[Any, float]]]:
-        """Top-k per query as (key, score) lists, best first. Scores follow the
-        metric's 'higher is better' convention (L2SQ is negated squared dist).
-        Accepts a device array directly (e.g. from ``encode_texts_device``) so
-        an encode→search chain costs one host round-trip, not two."""
-        self._flush()
+    def _prep_queries(self, queries: np.ndarray | jax.Array) -> jax.Array:
         if isinstance(queries, jax.Array):
             q = queries.astype(self.dtype)
             if q.ndim == 1:
@@ -399,12 +439,40 @@ class BruteForceKnnIndex:
             q = jnp.asarray(np.atleast_2d(np.asarray(queries, np.float32)), self.dtype)
         if q.shape[-1] != self.dimension:
             raise ValueError(f"query dim {q.shape[-1]} != {self.dimension}")
-        kk = min(k, self.capacity)
-        scores, slot_ids = _search_kernel(
+        return q
+
+    def search_device(
+        self, queries: np.ndarray | jax.Array, k: int
+    ) -> tuple[jax.Array, jax.Array]:
+        """Raw device-resident result: (scores [Q,k], slot ids [Q,k]) with NO
+        host sync — chain into further device ops or pack for one fetch."""
+        self._flush()
+        q = self._prep_queries(queries)
+        return _search_kernel(
             self._vectors, self._norms_sq, self._valid, self._key_bits, q,
-            k=kk, metric=self.metric.value,
+            k=min(k, self.capacity), metric=self.metric.value,
         )
-        return _decode_hits(np.asarray(scores), np.asarray(slot_ids), self._slot_to_key, k)
+
+    def search(
+        self, queries: np.ndarray, k: int
+    ) -> list[list[tuple[Any, float]]]:
+        """Top-k per query as (key, score) lists, best first. Scores follow the
+        metric's 'higher is better' convention (L2SQ is negated squared dist).
+        Accepts a device array directly (e.g. from ``encode_texts_device``) so
+        an encode→search chain costs one host round-trip, not two; scores and
+        ids come back packed in a single device→host fetch."""
+        scores, slot_ids = self.search_device(queries, k)
+        scores_np, ids_np = self._fetch_hits(scores, slot_ids)
+        return _decode_hits(scores_np, ids_np, self._slot_to_key, k)
+
+    def _fetch_hits(
+        self, scores: jax.Array, slot_ids: jax.Array
+    ) -> tuple[np.ndarray, np.ndarray]:
+        """One packed device→host fetch when the f32 value-cast of ids stays
+        exact (capacity < 2^24); two plain fetches otherwise."""
+        if self.capacity < (1 << 24):
+            return _unpack_hits(np.asarray(_pack_hits(scores, slot_ids)))
+        return np.asarray(scores), np.asarray(slot_ids)
 
 
 def sharded_search(
@@ -413,12 +481,18 @@ def sharded_search(
     vectors: jax.Array,    # [N, d] sharded on axis over N
     norms_sq: jax.Array,   # [N]
     valid: jax.Array,      # [N]
+    key_bits: jax.Array,   # [N] uint32, sharded on axis
     queries: jax.Array,    # [Q, d] replicated
     k: int,
     metric: str = "cos",
 ) -> tuple[jax.Array, jax.Array]:
     """Search a mesh-sharded KNN matrix: local einsum+top_k per device, all-gather
     of k candidates, global top-k merge. Returns (scores [Q,k], global slot ids).
+
+    Each shard's local cut uses the same canonical (score desc, key asc)
+    tie-break as the single-device kernel, so which equal-score documents
+    survive does not depend on the shard count (matches ``_decode_hits`` and
+    the cross-shard merge ordering).
     """
     n_shards = mesh.shape[axis]
     shard_n = vectors.shape[0] // n_shards
@@ -428,25 +502,28 @@ def sharded_search(
     # set is the entire index
     k_final = min(k, n_shards * k_local)
 
-    def local(vecs, nsq, val, q):
-        zero_bits = jnp.zeros(vecs.shape[0], dtype=jnp.uint32)
-        s, ids = _search_kernel(vecs, nsq, val, zero_bits, q, k=k_local, metric=metric)
+    def local(vecs, nsq, val, bits, q):
+        s, ids = _search_kernel(vecs, nsq, val, bits, q, k=k_local, metric=metric)
         shard_idx = jax.lax.axis_index(axis)
         gids = ids + shard_idx * shard_n
+        bsel = bits[ids]  # per-candidate key bits ride along for the merge
         # gather all shards' candidates: [n_shards*k_local] per query
         all_s = jax.lax.all_gather(s, axis, axis=1, tiled=True)
         all_g = jax.lax.all_gather(gids, axis, axis=1, tiled=True)
-        ms, mi = jax.lax.top_k(all_s, k_final)
+        all_b = jax.lax.all_gather(bsel, axis, axis=1, tiled=True)
+        # canonical merge: equal-score candidates cut by smaller key, NOT by
+        # shard order (plain top_k would prefer earlier shards on ties)
+        ms, mi = _canonical_select(all_s, all_b, k_final)
         return ms, jnp.take_along_axis(all_g, mi, axis=1)
 
     fn = shard_map(
         local,
         mesh=mesh,
-        in_specs=(P(axis, None), P(axis), P(axis), P(None, None)),
+        in_specs=(P(axis, None), P(axis), P(axis), P(axis), P(None, None)),
         out_specs=(P(None, None), P(None, None)),
         check_vma=False,
     )
-    return fn(vectors, norms_sq, valid, queries)
+    return fn(vectors, norms_sq, valid, key_bits, queries)
 
 
 class ShardedBruteForceKnnIndex(BruteForceKnnIndex):
@@ -480,6 +557,7 @@ class ShardedBruteForceKnnIndex(BruteForceKnnIndex):
         self._vectors = jax.device_put(self._vectors, self._sharding(P(self.axis, None)))
         self._norms_sq = jax.device_put(self._norms_sq, self._sharding(P(self.axis)))
         self._valid = jax.device_put(self._valid, self._sharding(P(self.axis)))
+        self._key_bits = jax.device_put(self._key_bits, self._sharding(P(self.axis)))
 
     def _grow(self) -> None:
         super()._grow()
@@ -491,9 +569,11 @@ class ShardedBruteForceKnnIndex(BruteForceKnnIndex):
 
     def search(self, queries: np.ndarray, k: int) -> list[list[tuple[Any, float]]]:
         self._flush()
-        q = jnp.asarray(np.atleast_2d(np.asarray(queries, np.float32)), self.dtype)
+        q = self._prep_queries(queries)
         scores, gids = sharded_search(
-            self.mesh, self.axis, self._vectors, self._norms_sq, self._valid, q,
+            self.mesh, self.axis, self._vectors, self._norms_sq, self._valid,
+            self._key_bits, q,
             k=min(k, self.capacity), metric=self.metric.value,
         )
-        return _decode_hits(np.asarray(scores), np.asarray(gids), self._slot_to_key, k)
+        scores_np, ids_np = self._fetch_hits(scores, gids)
+        return _decode_hits(scores_np, ids_np, self._slot_to_key, k)

@@ -28,6 +28,7 @@ import numpy as np
 
 from pathway_tpu.engine.blocks import DeltaBatch
 from pathway_tpu.engine.graph import Node
+from pathway_tpu.internals.keys import tie_order, tie_order_u64
 from pathway_tpu.stdlib.indexing._filters import compile_filter
 
 
@@ -158,7 +159,7 @@ class BM25Backend(IndexBackend):
                         * (self.K1 + 1)
                         / (tf + self.K1 * (1 - self.B + self.B * dl / avgdl))
                     )
-            ranked = sorted(scores.items(), key=lambda kv: (-kv[1], kv[0]))
+            ranked = sorted(scores.items(), key=lambda kv: (-kv[1], tie_order(kv[0])))
             picked = [
                 (key, float(s)) for key, s in ranked if flt(self.metadata.get(key))
             ][:k]
@@ -369,12 +370,17 @@ class MergeIndexRepliesNode(Node):
             if st["parts"]:
                 k = max(kk for (_p, kk) in st["parts"].values())
                 pairs = [p for (part, _kk) in st["parts"].values() for p in part]
-                pairs.sort(key=lambda ds: (-float(ds[1]), int(ds[0])))
+                pairs.sort(key=lambda ds: (-float(ds[1]), tie_order(int(ds[0]))))
                 merged: tuple | None = tuple(pairs[:k])
             else:
                 merged = None  # every shard retracted: the query is gone
             old = st["emitted"]
             if merged == old:
+                if merged is None:
+                    # insert+retract within one tick (or all shards retracted
+                    # before the first merge): nothing was ever emitted — drop
+                    # the entry instead of leaking {'parts': {}, 'emitted': None}
+                    del self.state[qk]
                 continue
             if old is not None:
                 out_keys.append(qk)
@@ -494,6 +500,6 @@ class LshVectorBackend(IndexBackend):
                 continue
             mat = np.stack([self.vectors[c] for c in good])
             scores = self._score(mat, qv)
-            order = np.lexsort((np.asarray(good, dtype=np.uint64), -scores))[:k]
+            order = np.lexsort((tie_order_u64(np.asarray(good, dtype=np.uint64)), -scores))[:k]
             out.append([(good[i], float(scores[i])) for i in order])
         return out

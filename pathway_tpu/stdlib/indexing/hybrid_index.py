@@ -55,7 +55,9 @@ class HybridIndex(InnerIndex):
             for lst in reply_lists:
                 for rank, (key, _s) in enumerate(lst or ()):
                     fused[key] = fused.get(key, 0.0) + 1.0 / (rrf_k + rank + 1)
-            ranked = sorted(fused.items(), key=lambda kv: (-kv[1], kv[0]))
+            from pathway_tpu.internals.keys import tie_order
+
+            ranked = sorted(fused.items(), key=lambda kv: (-kv[1], tie_order(kv[0])))
             return tuple(ranked[: int(limit)])
 
         return merged.select(
