@@ -5,8 +5,8 @@ Measurement honesty (r3 VERDICT "make the TPU actually busy" + variance items):
 
 - **The tunnel is part of the wall clock here.** This host reaches its single
   TPU chip through a network tunnel where every fresh device↔host transfer
-  costs a ~90-110 ms round trip and bulk host→device bandwidth is ~10-30 MB/s
-  (measured and reported as ``tunnel_rtt_ms`` / ``tunnel_put_mbps`` each run).
+  costs a ~90-110 ms round trip and bulk host→device bandwidth is ~10-50 MiB/s
+  (measured and reported as ``tunnel_rtt_ms`` / ``tunnel_put_mib_s`` each run).
   A co-located host (any real TPU-VM deployment) pays microseconds for the
   same transfers. Every latency metric is therefore reported twice:
   ``*_ms`` = end-to-end through the tunnel, and ``*_device_ms`` = on-device
@@ -81,7 +81,7 @@ def measure_tunnel() -> dict:
     rtt = statistics.median(once((8, 8)) for _ in range(5))
     big = statistics.median(once((4096, 128)) for _ in range(3))  # 1 MiB
     bw = 1.0 / max(big - rtt, 1e-3)
-    return {"tunnel_rtt_ms": round(rtt * 1e3, 1), "tunnel_put_mbps": round(bw, 1)}
+    return {"tunnel_rtt_ms": round(rtt * 1e3, 1), "tunnel_put_mib_s": round(bw, 1)}
 
 
 def bench_tpu(docs: list[str]) -> tuple[float, dict]:

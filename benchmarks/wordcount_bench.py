@@ -1,0 +1,100 @@
+"""configs[0] stand-in: the reference's wordcount workload on pathway_tpu.
+
+Reproduces ``integration_tests/wordcount/pw_wordcount.py`` (reference): a
+jsonlines file of ``{"word": w}`` rows → ``groupby(word).reduce(count)`` →
+csv output, at the harness default of 5,000,000 input lines
+(``integration_tests/wordcount/base.py:18``). The reference engine itself
+cannot run on this image (no wheel reachable, no rustc to build the PyO3
+crate — see BASELINE.md), so this measures OUR side of configs[0]; the
+streaming mode feeds the same rows through the live connector path in chunks
+so every engine tick pays parse + incremental-groupby + csv-diff costs.
+
+Usage: python benchmarks/wordcount_bench.py [n_lines] [--streaming]
+Prints one JSON line per mode.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import tempfile
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def gen_input(path: str, n: int, distinct: int = 5000) -> None:
+    rng = np.random.default_rng(0)
+    words = np.array([f"word{i}" for i in range(distinct)])
+    with open(path, "w") as f:
+        for start in range(0, n, 100_000):
+            chunk = words[rng.integers(0, distinct, size=min(100_000, n - start))]
+            f.write("".join('{"word": "%s"}\n' % w for w in chunk))
+
+
+def run_static(inp: str, out: str, n: int) -> dict:
+    import pathway_tpu as pw
+
+    class S(pw.Schema):
+        word: str
+
+    t0 = time.perf_counter()
+    words = pw.io.jsonlines.read(inp, schema=S, mode="static")
+    result = words.groupby(words.word).reduce(words.word, count=pw.reducers.count())
+    pw.io.csv.write(result, out)
+    pw.run(monitoring_level="none")
+    dt = time.perf_counter() - t0
+    return {"metric": "wordcount static rows/s", "value": round(n / dt, 0), "unit": "rows/s", "seconds": round(dt, 2)}
+
+
+def run_streaming(inp: str, out: str, n: int) -> dict:
+    """Same rows through the live path: a python connector replays the file in
+    chunks with advancing times, so the groupby state updates incrementally
+    and the csv sink writes diffs (matches the reference harness's streaming
+    mode, where the fs source tails a growing directory)."""
+    import pathway_tpu as pw
+
+    class S(pw.Schema):
+        word: str
+
+    chunk_rows = 50_000
+
+    class Replay(pw.io.python.ConnectorSubject):
+        def run(self):
+            batch = []
+            with open(inp) as f:
+                for line in f:
+                    batch.append(json.loads(line)["word"])
+                    if len(batch) >= chunk_rows:
+                        self.next_batch([{"word": w} for w in batch])
+                        self.commit()
+                        batch = []
+            if batch:
+                self.next_batch([{"word": w} for w in batch])
+
+    t0 = time.perf_counter()
+    words = pw.io.python.read(Replay(), schema=S)
+    result = words.groupby(words.word).reduce(words.word, count=pw.reducers.count())
+    pw.io.csv.write(result, out)
+    pw.run(monitoring_level="none")
+    dt = time.perf_counter() - t0
+    return {"metric": "wordcount streaming rows/s", "value": round(n / dt, 0), "unit": "rows/s", "seconds": round(dt, 2)}
+
+
+def main() -> None:
+    n = int(sys.argv[1]) if len(sys.argv) > 1 and sys.argv[1].isdigit() else 5_000_000
+    streaming = "--streaming" in sys.argv
+    with tempfile.TemporaryDirectory() as d:
+        inp = os.path.join(d, "input.jsonl")
+        gen_input(inp, n)
+        if streaming:
+            print(json.dumps(run_streaming(inp, os.path.join(d, "out_s.csv"), n)))
+        else:
+            print(json.dumps(run_static(inp, os.path.join(d, "out.csv"), n)))
+
+
+if __name__ == "__main__":
+    main()
