@@ -1,0 +1,142 @@
+"""VERDICT r3 #3 — prove or disprove the jitted-relational-kernel bet.
+
+Measures the two flag-gated device kernels (`engine/jax_kernels.py`) against
+the engine's production numpy path on identical data:
+
+  - groupby: stable argsort + segment/weighted sums over (u64 key, int col,
+    float col) blocks — the exact work of ``GroupByNode._process_columnar``.
+  - join probe: two-sided searchsorted of probe keys against sorted state —
+    the exact inner kernel of ``ColumnarMultimap.match``.
+
+Run: ``python benchmarks/jax_kernel_bench.py [N]``. Prints one JSON line with
+rows/s for numpy, jax-CPU, and (when present) jax-TPU device-resident and
+e2e-with-transfer variants. The verdict recorded in BASELINE.md comes from
+this harness.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import statistics
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def _mk_data(n: int, n_groups: int):
+    rng = np.random.default_rng(0)
+    keys = rng.integers(0, n_groups, n).astype(np.uint64)
+    keys = (keys * np.uint64(0x9E3779B97F4A7C15)) ^ np.uint64(0x85EBCA6B)  # spread
+    diffs = np.ones(n, dtype=np.int64)
+    ic = rng.integers(0, 100, n).astype(np.int64)
+    fc = rng.random(n)
+    return keys, diffs, ic, fc
+
+
+def numpy_groupby(keys, diffs, ic, fc):
+    order = np.argsort(keys, kind="stable")
+    ks = keys[order]
+    starts = np.flatnonzero(np.concatenate([[True], ks[1:] != ks[:-1]]))
+    counts = np.add.reduceat(diffs[order], starts)
+    s1 = np.add.reduceat(ic[order] * diffs[order], starts)
+    s2 = np.add.reduceat(fc[order] * diffs[order], starts)
+    return ks[starts], counts, s1, s2
+
+
+def _time(fn, reps=3):
+    best = float("inf")
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        fn()
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+def run(n: int = 1_000_000) -> dict:
+    from pathway_tpu.engine import jax_kernels
+
+    saved_flag = os.environ.get("PATHWAY_ENGINE_JAX")
+    n_groups = max(n // 10, 1)
+    keys, diffs, ic, fc = _mk_data(n, n_groups)
+    out: dict = {"n": n}
+
+    # ---- groupby: numpy production path
+    t = _time(lambda: numpy_groupby(keys, diffs, ic, fc))
+    out["numpy_groupby_rows_per_s"] = round(n / t, 0)
+    u_np, c_np, s1_np, s2_np = numpy_groupby(keys, diffs, ic, fc)
+
+    # ---- groupby: jax kernels per backend
+    import jax
+
+    backends = {"cpu"}
+    try:
+        jax.local_devices(backend="tpu")
+        backends.add("tpu")
+    except RuntimeError:
+        pass
+    for backend in sorted(backends):
+        os.environ["PATHWAY_ENGINE_JAX"] = backend
+        try:
+            # correctness + warmup/compile
+            order, starts, u, c, (s1, s2) = (
+                lambda r: (r[0], r[1], r[2], r[3], r[4])
+            )(jax_kernels.grouped_sums(keys, diffs, [ic, fc.copy()]))
+            assert np.array_equal(u, u_np) and np.array_equal(c, c_np)
+            assert np.array_equal(s1, s1_np) and np.allclose(s2, s2_np)
+            t = _time(lambda: jax_kernels.grouped_sums(keys, diffs, [ic, fc]))
+            out[f"jax_{backend}_groupby_rows_per_s"] = round(n / t, 0)
+        except Exception as e:  # pragma: no cover
+            out[f"jax_{backend}_groupby_error"] = repr(e)[:200]
+
+        # device-resident variant: amortize transfer, measure kernel alone
+        try:
+            enable_x64 = __import__("jax").enable_x64
+
+            with enable_x64():
+                dev = jax.local_devices(backend=backend)[0]
+                dk, dd, di, df = jax.device_put((keys, diffs, ic, fc), dev)
+                kern = jax_kernels._jit_grouped(2)
+                kern(dk, dd, (di, df))[0].block_until_ready()  # compile
+                t = _time(lambda: kern(dk, dd, (di, df))[3].block_until_ready())
+            out[f"jax_{backend}_groupby_device_rows_per_s"] = round(n / t, 0)
+        except Exception as e:  # pragma: no cover
+            out[f"jax_{backend}_groupby_device_error"] = repr(e)[:200]
+
+    # ---- join probe: 10% of n unique sorted state keys, n probes
+    state = np.sort(np.unique(keys))[: max(n // 10, 1)]
+    probes = keys
+
+    def np_probe():
+        lo = np.searchsorted(state, probes, side="left")
+        return lo, np.searchsorted(state, probes, side="right") - lo
+
+    t = _time(np_probe)
+    out["numpy_probe_rows_per_s"] = round(n / t, 0)
+    lo_np, cnt_np = np_probe()
+    for backend in sorted(backends):
+        os.environ["PATHWAY_ENGINE_JAX"] = backend
+        try:
+            lo, cnt = jax_kernels.join_probe(state, probes)  # compile+check
+            assert np.array_equal(lo, lo_np) and np.array_equal(cnt, cnt_np)
+            t = _time(lambda: jax_kernels.join_probe(state, probes))
+            out[f"jax_{backend}_probe_rows_per_s"] = round(n / t, 0)
+        except Exception as e:  # pragma: no cover
+            out[f"jax_{backend}_probe_error"] = repr(e)[:200]
+
+    if saved_flag is None:
+        os.environ.pop("PATHWAY_ENGINE_JAX", None)
+    else:
+        os.environ["PATHWAY_ENGINE_JAX"] = saved_flag
+    # the headline adoption number: best jax groupby throughput (host-fed)
+    cands = [v for k, v in out.items() if k.startswith("jax_") and k.endswith("groupby_rows_per_s")]
+    out["jax_kernel_rows_per_s"] = max(cands) if cands else None
+    return out
+
+
+if __name__ == "__main__":
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 1_000_000
+    print(json.dumps(run(n)))

@@ -17,6 +17,7 @@ from __future__ import annotations
 
 import numpy as np
 
+from pathway_tpu.engine import jax_kernels
 from pathway_tpu.engine.blocks import concat_cols, group_starts
 
 
@@ -189,9 +190,17 @@ class ColumnarMultimap:
                 if seg.probes >= 2 or len(seg) <= max(self.SMALL_SEGMENT, len(q_jk)):
                     seg.sort()
             if seg.sorted:
-                lo = np.searchsorted(seg.jk, q_jk, side="left")
-                hi = np.searchsorted(seg.jk, q_jk, side="right")
-                q_idx, ofs = _expand_ranges(lo, hi - lo)
+                lo = cnt = None
+                if jax_kernels.probe_eligible(len(seg), len(q_jk)):
+                    try:
+                        lo, cnt = jax_kernels.join_probe(seg.jk, q_jk)
+                    except Exception:  # jax runtime failure → numpy, stop routing
+                        jax_kernels.disable()
+                        lo = cnt = None
+                if lo is None:
+                    lo = np.searchsorted(seg.jk, q_jk, side="left")
+                    cnt = np.searchsorted(seg.jk, q_jk, side="right") - lo
+                q_idx, ofs = _expand_ranges(lo, cnt)
             else:
                 if q_order is None:
                     q_order = np.argsort(q_jk, kind="stable")

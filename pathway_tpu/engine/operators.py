@@ -25,6 +25,7 @@ from pathway_tpu.engine.blocks import (
     group_starts,
     make_column,
 )
+from pathway_tpu.engine import jax_kernels
 from pathway_tpu.engine.colstore import ColumnarKeyedStore, ColumnarMultimap, SortedCounts
 from pathway_tpu.engine.graph import END_OF_STREAM, SOLO, Node
 from pathway_tpu.engine.reducers_impl import ReducerImpl
@@ -464,19 +465,23 @@ class GroupByNode(Node):
         block merges in with searchsorted + reduceat; no per-group Python.
         Returns None when this batch's columns can't vectorize (→ dict path)."""
         gkeys = self._gkeys(batch)
-        order = np.argsort(gkeys, kind="stable")
-        gk_sorted = gkeys[order]
-        starts = group_starts(gk_sorted)
         diffs = batch.diffs
-        partials: list[np.ndarray] = []
-        for (_, impl, cols) in self.reducer_specs:
-            arrays = [batch.data[c] for c in cols]
-            p = impl.grouped_partials_np(arrays, diffs, order, starts)
-            if p is None:
-                return None
-            partials.append(p)
-        u_gk = gk_sorted[starts]
-        counts = np.add.reduceat(diffs[order], starts)
+        jaxed = jax_kernels.try_grouped(gkeys, diffs, self.reducer_specs, batch.data)
+        if jaxed is not None:
+            order, starts, u_gk, counts, partials = jaxed
+        else:
+            order = np.argsort(gkeys, kind="stable")
+            gk_sorted = gkeys[order]
+            starts = group_starts(gk_sorted)
+            partials = []
+            for (_, impl, cols) in self.reducer_specs:
+                arrays = [batch.data[c] for c in cols]
+                p = impl.grouped_partials_np(arrays, diffs, order, starts)
+                if p is None:
+                    return None
+                partials.append(p)
+            u_gk = gk_sorted[starts]
+            counts = np.add.reduceat(diffs[order], starts)
         first_rows = order[starts]
         batch_gcols = [batch.data[c][first_rows] for c in self.group_cols]
 

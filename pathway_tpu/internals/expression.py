@@ -6,8 +6,11 @@ async-apply/cast/convert/coalesce/require/if_else/pointer/make_tuple/get/method-
 unwrap/fill_error) with the same user surface. Unlike the reference — which compiles
 these per-row into a Rust expression VM (``src/engine/expression.rs``) — this AST is
 compiled into **vectorized columnar kernels** over delta blocks
-(``pathway_tpu/engine/expression_vm.py``): numpy ufuncs on the host path and jitted
-JAX for large numeric blocks, so the MXU/VPU see whole batches instead of rows.
+(``pathway_tpu/engine/expression_vm.py``): numpy ufuncs on the host. Offloading
+relational blocks to jitted JAX was measured in ``benchmarks/jax_kernel_bench.py``
+and adopted only where it won — the join probe (``engine/jax_kernels.py``); the
+expression VM itself stays numpy (the measured-faster path), and device compute is
+reserved for the FLOP-dense ops (encoder/KNN/reranker).
 """
 
 from __future__ import annotations

@@ -18,7 +18,7 @@ import numpy as np
 from pathway_tpu.engine import operators as ops
 from pathway_tpu.engine.graph import Node
 from pathway_tpu.internals import schema as schema_mod
-from pathway_tpu.internals.keys import row_keys, sequential_keys, splitmix64
+from pathway_tpu.internals.keys import row_keys, sequential_keys
 from pathway_tpu.internals.logical import LogicalNode
 from pathway_tpu.internals.table import Table
 from pathway_tpu.internals.universe import Universe
@@ -53,21 +53,8 @@ class ConnectorSubject:
         bit-identical to calling ``next`` row by row."""
         if not rows:
             return
-        cols = self._columns
-        values = [tuple(r.get(c) for c in cols) for r in rows]
-        n = len(values)
-        if self._pk_cols:
-            idx = [cols.index(c) for c in self._pk_cols]
-            arrs = []
-            for i in idx:
-                a = np.empty(n, dtype=object)
-                a[:] = [v[i] for v in values]
-                arrs.append(a)
-            keys = row_keys(arrs, n=n)
-        else:
-            start = self._seq + 1
-            self._seq += n
-            keys = sequential_keys(start, n)
+        values = [tuple(r.get(c) for c in self._columns) for r in rows]
+        keys = self._keys_for(values)
         assert self._node is not None, "subject not attached to a running graph"
         self._node.push_many(
             (int(k), v, 1) for k, v in zip(keys, values)
@@ -93,17 +80,25 @@ class ConnectorSubject:
         return "native"
 
     # ---- internals ----
-    def _key_of(self, values: tuple) -> int:
+    def _keys_for(self, values: list[tuple]) -> np.ndarray:
+        """Row keys for a block of value tuples — the single source of the
+        key-derivation recipe, shared by per-row ``next``/``_remove`` (n=1)
+        and ``next_batch`` so the two stay bit-identical by construction."""
+        n = len(values)
         if self._pk_cols:
             idx = [self._columns.index(c) for c in self._pk_cols]
             arrs = []
             for i in idx:
-                a = np.empty(1, dtype=object)
-                a[0] = values[i]
+                a = np.empty(n, dtype=object)
+                a[:] = [v[i] for v in values]
                 arrs.append(a)
-            return int(row_keys(arrs, n=1)[0])
-        self._seq += 1
-        return int(splitmix64(np.asarray([self._seq], dtype=np.uint64))[0])
+            return row_keys(arrs, n=n)
+        start = self._seq + 1
+        self._seq += n
+        return sequential_keys(start, n)
+
+    def _key_of(self, values: tuple) -> int:
+        return int(self._keys_for([values])[0])
 
     def _push(self, values: tuple, diff: int) -> None:
         assert self._node is not None, "subject not attached to a running graph"
