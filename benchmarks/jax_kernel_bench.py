@@ -38,13 +38,10 @@ def _mk_data(n: int, n_groups: int):
 
 
 def numpy_groupby(keys, diffs, ic, fc):
-    order = np.argsort(keys, kind="stable")
-    ks = keys[order]
-    starts = np.flatnonzero(np.concatenate([[True], ks[1:] != ks[:-1]]))
-    counts = np.add.reduceat(diffs[order], starts)
-    s1 = np.add.reduceat(ic[order] * diffs[order], starts)
-    s2 = np.add.reduceat(fc[order] * diffs[order], starts)
-    return ks[starts], counts, s1, s2
+    from pathway_tpu.engine.jax_kernels import numpy_grouped_sums
+
+    _order, _starts, u, counts, (s1, s2) = numpy_grouped_sums(keys, diffs, [ic, fc])
+    return u, counts, s1, s2
 
 
 def _time(fn, reps=3):
@@ -82,9 +79,9 @@ def run(n: int = 1_000_000) -> dict:
         os.environ["PATHWAY_ENGINE_JAX"] = backend
         try:
             # correctness + warmup/compile
-            order, starts, u, c, (s1, s2) = (
-                lambda r: (r[0], r[1], r[2], r[3], r[4])
-            )(jax_kernels.grouped_sums(keys, diffs, [ic, fc.copy()]))
+            order, starts, u, c, (s1, s2) = jax_kernels.grouped_sums(
+                keys, diffs, [ic, fc.copy()]
+            )
             assert np.array_equal(u, u_np) and np.array_equal(c, c_np)
             assert np.array_equal(s1, s1_np) and np.allclose(s2, s2_np)
             t = _time(lambda: jax_kernels.grouped_sums(keys, diffs, [ic, fc]))

@@ -195,6 +195,14 @@ class ColumnarMultimap:
                     try:
                         lo, cnt = jax_kernels.join_probe(seg.jk, q_jk)
                     except Exception:  # jax runtime failure → numpy, stop routing
+                        import logging
+
+                        logging.getLogger(__name__).warning(
+                            "JAX join-probe kernel failed; falling back to "
+                            "numpy and disabling kernel routing for this "
+                            "process",
+                            exc_info=True,
+                        )
                         jax_kernels.disable()
                         lo = cnt = None
                 if lo is None:

@@ -36,6 +36,7 @@ expression VM + differential arrangements (``src/engine/expression.rs``,
 from __future__ import annotations
 
 import os
+import threading
 import weakref
 from functools import partial
 from typing import Any
@@ -109,6 +110,24 @@ def _jit_grouped(n_cols: int):
 
 
 _GROUPED_JIT: dict[int, Any] = {}
+
+
+def numpy_grouped_sums(
+    gkeys: np.ndarray, diffs: np.ndarray, sum_cols: list[np.ndarray]
+) -> tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray, list[np.ndarray]]:
+    """The numpy reference for :func:`grouped_sums` — the same
+    argsort+reduceat recipe ``GroupByNode._process_columnar`` runs (shared
+    here so benchmarks/tests compare against one implementation; the
+    pipeline-level parity test in ``tests/test_jax_kernels.py`` guards the
+    production path itself)."""
+    from pathway_tpu.engine.blocks import group_starts
+
+    order = np.argsort(gkeys, kind="stable")
+    ks = gkeys[order]
+    starts = group_starts(ks)
+    counts = np.add.reduceat(diffs[order], starts) if len(ks) else np.empty(0, np.int64)
+    sums = [np.add.reduceat(c[order] * diffs[order], starts) for c in sum_cols]
+    return order, starts, ks[starts], counts, sums
 
 
 def grouped_sums(
@@ -244,21 +263,25 @@ def _bucket(n: int) -> int:
 # Sorted state segments are immutable between compactions and probed many
 # times; cache their padded copies so the pad memcpy is paid once, not per
 # probe. Keyed by id() with a liveness weakref guard (ids recycle after GC).
+# Locked: sharded-runtime worker threads probe concurrently.
 _PAD_CACHE: dict[int, tuple[Any, np.ndarray]] = {}
+_PAD_LOCK = threading.Lock()
 
 
 def _padded_state(arr: np.ndarray, bs: int) -> np.ndarray:
-    ent = _PAD_CACHE.get(id(arr))
-    if ent is not None and ent[0]() is arr and len(ent[1]) == bs:
-        return ent[1]
+    with _PAD_LOCK:
+        ent = _PAD_CACHE.get(id(arr))
+        if ent is not None and ent[0]() is arr and len(ent[1]) == bs:
+            return ent[1]
     padded = np.concatenate([arr, np.full(bs - len(arr), _PAD_KEY, dtype=np.uint64)])
-    dead = [k for k, (r, _) in _PAD_CACHE.items() if r() is None]
-    for k in dead:
-        del _PAD_CACHE[k]
-    try:
-        _PAD_CACHE[id(arr)] = (weakref.ref(arr), padded)
-    except TypeError:  # pragma: no cover - non-weakref-able array subclass
-        pass
+    with _PAD_LOCK:
+        dead = [k for k, (r, _) in _PAD_CACHE.items() if r() is None]
+        for k in dead:
+            del _PAD_CACHE[k]
+        try:
+            _PAD_CACHE[id(arr)] = (weakref.ref(arr), padded)
+        except TypeError:  # pragma: no cover - non-weakref-able array subclass
+            pass
     return padded
 
 

@@ -241,28 +241,56 @@ class _OperatorSnapshots:
         responsibility, as in the reference's persistent-id contract)."""
         return self.manifest is not None and self.manifest.get("node_names") == signature
 
-    def restore(self, nodes: list) -> None:
+    def stored_workers(self) -> int:
+        return self.manifest.get("n_workers", 1) if self.manifest else 1
+
+    def restore(self, worker_nodes: list[list]) -> None:
+        """Per-worker state restore (reference: every worker's operators are
+        wrapped individually, ``dataflow/persist.rs:843``). State shards are
+        positional per (worker, node), so worker count must match — checked
+        by the caller against the manifest. Stores written before per-worker
+        layout (manifest without ``n_workers``) used un-prefixed node keys;
+        they are single-worker by construction (the old code refused
+        multi-worker runtimes) and restore through the legacy path."""
         g = self.manifest["gen"]
-        for node in nodes:
-            raw = self.backend.get(f"operators/gen_{g:08d}/node_{node.node_index:05d}")
-            if raw is not None:
-                node.restore_state(pickle.loads(raw))
+        legacy = "n_workers" not in self.manifest
+        for w, nodes in enumerate(worker_nodes):
+            for node in nodes:
+                key = (
+                    f"operators/gen_{g:08d}/node_{node.node_index:05d}"
+                    if legacy
+                    else f"operators/gen_{g:08d}/worker_{w:03d}/node_{node.node_index:05d}"
+                )
+                raw = self.backend.get(key)
+                if raw is not None:
+                    node.restore_state(pickle.loads(raw))
 
     def save(
         self,
-        nodes: list,
+        worker_nodes: list[list],
         node_names: list[str],
         input_offsets: dict[str, int],
         tick: int,
     ) -> None:
+        """Snapshot every worker's node shards at a quiesced tick boundary.
+
+        The global-consistency argument mirrors the reference's finalized-time
+        consensus (``src/persistence/state.rs:291``): this runs from
+        ``on_tick_done``, after ``run_tick`` has drained every worker and the
+        barrier rounds found no pending work anywhere — so all workers' state
+        reflects exactly the same input prefix (the one ``input_offsets``
+        records), and a single manifest commit covers all shards atomically.
+        """
         g = self.gen
-        for node in nodes:
-            state = node.snapshot_state()
-            if state is None:
-                continue
-            self.backend.put(
-                f"operators/gen_{g:08d}/node_{node.node_index:05d}", pickle.dumps(state)
-            )
+        for w, nodes in enumerate(worker_nodes):
+            for node in nodes:
+                state = node.snapshot_state()
+                if state is None:
+                    continue
+                self.backend.put(
+                    f"operators/gen_{g:08d}/worker_{w:03d}/node_{node.node_index:05d}",
+                    pickle.dumps(state),
+                )
         # the manifest is the commit point: readers only ever follow it
         self.backend.put(
             _MANIFEST,
@@ -272,6 +300,7 @@ class _OperatorSnapshots:
                     "tick": tick,
                     "input_offsets": input_offsets,
                     "node_names": node_names,
+                    "n_workers": len(worker_nodes),
                 }
             ),
         )
@@ -290,14 +319,20 @@ class Persistence:
         self.operator_mode = config.persistence_mode == "operator_persisting"
         self.inputs: list[_PersistedInput] = []
         self.opsnap: _OperatorSnapshots | None = None
-        self._nodes: list = []
+        self._worker_nodes: list[list] = []
         self._node_names: list = []
 
     # called by Runtime once the engine graph is built, before drivers start
     def on_graph_built(self, ctx) -> None:
         offsets: dict[str, int] = {}
         if self.operator_mode:
-            self._nodes = list(ctx.graph.nodes)
+            # sharded runtimes hold per-worker aligned node shards; the single
+            # runtime is the 1-worker case of the same layout
+            workers = getattr(self.runtime, "workers", None)
+            if workers:
+                self._worker_nodes = [list(w.graph.nodes) for w in workers]
+            else:
+                self._worker_nodes = [list(ctx.graph.nodes)]
             self._node_names = [
                 (
                     n.name,
@@ -305,12 +340,22 @@ class Persistence:
                     tuple(getattr(n, "columns", None) or getattr(n, "out_columns", []) or []),
                     tuple(ctx.graph.edges.get(n.node_index, [])),
                 )
-                for n in self._nodes
+                for n in self._worker_nodes[0]
             ]
             self.opsnap = _OperatorSnapshots(
                 self.backend, self.config.snapshot_interval_ms / 1000.0
             )
             if self.opsnap.manifest is not None:
+                if self.opsnap.stored_workers() != len(self._worker_nodes):
+                    # state shards are positional per worker; resharding them
+                    # on restart is future work — refuse loudly (compaction
+                    # already dropped the log prefix, so recompute is impossible)
+                    raise RuntimeError(
+                        "operator_persisting: persisted snapshots were taken "
+                        f"with {self.opsnap.stored_workers()} worker(s) but "
+                        f"this run has {len(self._worker_nodes)}; restart with "
+                        "the same worker count or clear the persistence storage"
+                    )
                 if not self.opsnap.validate(self._node_names):
                     # operator snapshots are positional AND compaction already
                     # dropped the consumed log prefix — a different graph can
@@ -324,7 +369,7 @@ class Persistence:
                         "storage or revert the pipeline change"
                     )
                 offsets = dict(self.opsnap.manifest["input_offsets"])
-                self.opsnap.restore(self._nodes)
+                self.opsnap.restore(self._worker_nodes)
         # pid stability: a source keeps its snapshots across unrelated pipeline
         # edits — use the connector's name alone when unique among sources, and
         # only disambiguate same-named sources by their order among sources
@@ -368,7 +413,7 @@ class Persistence:
     def _save_operators(self, time: int) -> None:
         assert self.opsnap is not None
         offsets = {p.pid: p.consumed() for p in self.inputs}
-        self.opsnap.save(self._nodes, self._node_names, offsets, time)
+        self.opsnap.save(self._worker_nodes, self._node_names, offsets, time)
         for p in self.inputs:
             p.trim(offsets[p.pid])
 
@@ -387,15 +432,19 @@ class Persistence:
 
 def attach(runtime, config) -> None:
     from pathway_tpu.engine.runtime import Runtime as _SingleRuntime
+    from pathway_tpu.parallel.sharded import ShardedRuntime as _ShardedRuntime
 
-    if config.persistence_mode == "operator_persisting" and type(runtime) is not _SingleRuntime:
-        # sharded/cluster runtimes hold per-worker node shards; snapshotting
-        # only worker 0 while compacting the full log would silently lose the
-        # other workers' state — refuse until per-worker snapshots land
+    if config.persistence_mode == "operator_persisting" and type(runtime) not in (
+        _SingleRuntime,
+        _ShardedRuntime,
+    ):
+        # the multi-process cluster runtime has no shared storage view or
+        # cross-process quiesce hook yet; sharded (threads) snapshots every
+        # worker's shards per generation (see _OperatorSnapshots.save)
         raise NotImplementedError(
-            "operator_persisting currently requires a single-worker runtime "
-            "(PATHWAY_THREADS=1, PATHWAY_PROCESSES=1); use the default "
-            "input-snapshot mode for multi-worker runs"
+            "operator_persisting currently requires a single-process runtime "
+            "(PATHWAY_PROCESSES=1; any PATHWAY_THREADS); use the default "
+            "input-snapshot mode for multi-process runs"
         )
     runtime.persistence = Persistence(config, runtime)
     if config.backend.kind == "filesystem" and config.backend.path:

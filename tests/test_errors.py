@@ -122,13 +122,19 @@ def test_sharded_terminate_on_error_aborts():
         pw.run(n_workers=2)
 
 
-def test_operator_persisting_refused_on_sharded():
-    _failing_pipeline()
-    with pytest.raises(NotImplementedError, match="single-worker"):
-        pw.run(
-            n_workers=2,
-            terminate_on_error=False,
-            persistence_config=pw.persistence.Config(
+def test_operator_persisting_refused_on_cluster():
+    """Sharded (threads) now snapshots per worker; only the multi-process
+    cluster runtime — no shared storage view — still refuses operator mode."""
+    from pathway_tpu.parallel.cluster import ClusterRuntime
+    from pathway_tpu.persistence.snapshots import attach
+
+    # the real type, uninitialized: attach's guard is a type check and must
+    # fire before any runtime state is touched
+    rt = ClusterRuntime.__new__(ClusterRuntime)
+    with pytest.raises(NotImplementedError, match="single-process"):
+        attach(
+            rt,
+            pw.persistence.Config(
                 backend=pw.persistence.Backend.memory(),
                 persistence_mode="operator_persisting",
             ),

@@ -20,15 +20,6 @@ pytestmark = pytest.mark.skipif(
 )
 
 
-def _numpy_grouped(keys, diffs, cols):
-    order = np.argsort(keys, kind="stable")
-    ks = keys[order]
-    starts = np.flatnonzero(np.concatenate([[True], ks[1:] != ks[:-1]]))
-    counts = np.add.reduceat(diffs[order], starts)
-    sums = [np.add.reduceat(c[order] * diffs[order], starts) for c in cols]
-    return order, starts, ks[starts], counts, sums
-
-
 def test_grouped_sums_bit_parity(monkeypatch):
     monkeypatch.setenv("PATHWAY_ENGINE_JAX", "cpu")
     rng = np.random.default_rng(7)
@@ -37,12 +28,8 @@ def test_grouped_sums_bit_parity(monkeypatch):
     diffs = rng.choice([-1, 1, 1, 2], n).astype(np.int64)
     ic = rng.integers(-50, 50, n).astype(np.int64)
     fc = rng.random(n)
-    order, starts, u, c, (s1, s2) = (
-        lambda r: (r[0], r[1], r[2], r[3], r[4])
-    )(jax_kernels.grouped_sums(keys, diffs, [ic, fc]))
-    o2, st2, u2, c2, (t1, t2) = (
-        lambda r: (r[0], r[1], r[2], r[3], r[4])
-    )(_numpy_grouped(keys, diffs, [ic, fc]))
+    order, starts, u, c, (s1, s2) = jax_kernels.grouped_sums(keys, diffs, [ic, fc])
+    o2, st2, u2, c2, (t1, t2) = jax_kernels.numpy_grouped_sums(keys, diffs, [ic, fc])
     np.testing.assert_array_equal(order, o2)  # stable sort parity
     np.testing.assert_array_equal(starts, st2)
     np.testing.assert_array_equal(u, u2)

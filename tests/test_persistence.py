@@ -159,7 +159,9 @@ def test_operator_persisting_mode_accepted():
 # ---------------------------------------------------------------- operator mode
 
 
-def run_operator_session(rows, backend, collect, mode="operator_persisting"):
+def run_operator_session(
+    rows, backend, collect, mode="operator_persisting", n_workers=None
+):
     G.clear()
     subj = ListSubject(rows)
     t = pw.io.python.read(subj, schema=S, name="wordsource")
@@ -176,9 +178,10 @@ def run_operator_session(rows, backend, collect, mode="operator_persisting"):
         else None,
     )
     pw.run(
+        n_workers=n_workers,
         persistence_config=pw.persistence.Config(
             backend=backend, persistence_mode=mode
-        )
+        ),
     )
     collect.update(results)
     return subj
@@ -273,6 +276,218 @@ def test_operator_snapshot_graph_change_is_refused(tmp_path):
                 backend=backend, persistence_mode="operator_persisting"
             )
         )
+
+
+def test_operator_snapshot_multiworker_o_state(tmp_path):
+    """VERDICT r3 #4: per-worker operator snapshots on the sharded runtime.
+
+    A 4-worker run snapshots every worker's state shards; the restart (also
+    4 workers) must restore them, replay only the log suffix, and emit only
+    NEW deltas — byte-identical values to the single-worker contract."""
+    backend = pw.persistence.Backend.filesystem(str(tmp_path / "pstate"))
+
+    out1: dict = {}
+    run_operator_session(
+        [("a", 1), ("b", 2), ("a", 3), ("c", 7)], backend, out1, n_workers=4
+    )
+    assert out1 == {"a": 4, "b": 2, "c": 7}
+
+    import pathway_tpu.persistence.snapshots as snapmod
+
+    pushed_on_replay: list = []
+    orig_replay = snapmod._PersistedInput.replay
+
+    def counting_replay(self):
+        orig_push = self._original_push
+
+        def probe(key, values, diff):
+            pushed_on_replay.append((key, values, diff))
+            orig_push(key, values, diff)
+
+        self._original_push = probe
+        try:
+            orig_replay(self)
+        finally:
+            self._original_push = orig_push
+
+    snapmod._PersistedInput.replay = counting_replay
+    try:
+        out2: dict = {}
+        run_operator_session(
+            [("a", 1), ("b", 2), ("a", 3), ("c", 7), ("b", 10), ("d", 5)],
+            backend,
+            out2,
+            n_workers=4,
+        )
+    finally:
+        snapmod._PersistedInput.replay = orig_replay
+    # state covered all 4 events of run 1 -> zero replayed, suffix only
+    assert pushed_on_replay == [], pushed_on_replay
+    # only groups touched by the suffix re-emit (O(state) restart)
+    assert out2 == {"b": 12, "d": 5}
+
+
+def test_operator_snapshot_worker_count_mismatch_refused(tmp_path):
+    """State shards are positional per worker: a restart with a different
+    worker count cannot restore them (and compaction dropped the log)."""
+    backend = pw.persistence.Backend.filesystem(str(tmp_path / "pstate"))
+    out1: dict = {}
+    run_operator_session([("a", 1), ("b", 2)], backend, out1, n_workers=4)
+    with pytest.raises(RuntimeError, match="worker"):
+        run_operator_session([("a", 1), ("b", 2)], backend, {}, n_workers=2)
+
+
+_WORDCOUNT_OP = """
+import os
+import sys
+
+import pathway_tpu as pw
+from pathway_tpu.io.kafka import MockKafkaBroker
+
+broker = MockKafkaBroker(path=os.environ["BROKER_PATH"])
+expected = int(os.environ["EXPECTED_WORDS"])
+words = pw.io.kafka.read(
+    broker, "words", format="plaintext", mode="streaming", name="words"
+)
+counts = words.groupby(words.data).reduce(words.data, c=pw.reducers.count())
+pw.io.fs.write(counts, sys.argv[1], format="csv")
+
+# stop on the ABSOLUTE total (a restored restart only re-emits deltas, so
+# counting emitted rows would never reach the target after recovery)
+total = counts.reduce(s=pw.reducers.sum(pw.this.c))
+
+def on_total(key, row, time, is_addition):
+    if is_addition and row["s"] >= expected:
+        rt = pw.internals.run.current_runtime()
+        if rt is not None:
+            rt.request_stop()
+
+pw.io.subscribe(total, on_change=on_total)
+pw.run(
+    persistence_config=pw.persistence.Config(
+        backend=pw.persistence.Backend.filesystem(os.environ["PSTORE"]),
+        persistence_mode="operator_persisting",
+        snapshot_interval_ms=150,
+    )
+)
+"""
+
+
+def _net_counts(path):
+    import csv as _csv
+
+    state: dict = {}
+    with open(path) as fh:
+        for rec in _csv.DictReader(fh):
+            w, c, d = rec["data"], int(rec["c"]), int(rec["diff"])
+            state[w] = state.get(w, 0) + c * d
+            if state[w] == 0:
+                del state[w]
+    return state
+
+
+def test_operator_kill_restart_multiworker(tmp_path):
+    """VERDICT r3 #4 done-criterion: SIGKILL mid-stream at PATHWAY_THREADS=4,
+    restart recovers O(state) from per-worker snapshots, combined output is
+    byte-identical to ground truth."""
+    import os
+    import pickle
+    import signal
+    import subprocess
+    import sys as _sys
+    import time as _time
+
+    script = tmp_path / "wc_op.py"
+    script.write_text(_WORDCOUNT_OP)
+    broker_path = str(tmp_path / "broker")
+    pstore = str(tmp_path / "pstore")
+    # node signatures cover the sink path, so both runs share one output
+    # file; run 1's rows are copied aside before the restart truncates it
+    out = str(tmp_path / "out.csv")
+    out1 = str(tmp_path / "out1_saved.csv")
+
+    # first half includes words that never appear again: their aggregates must
+    # NOT be re-emitted by the restart (the O(state) proof)
+    first = [f"w{i % 17}" for i in range(160)] + [f"only{i % 5}" for i in range(40)]
+    second = [f"w{i % 17}" for i in range(200)]
+
+    from pathway_tpu.io.kafka import MockKafkaBroker
+
+    broker = MockKafkaBroker(path=broker_path)
+    broker.create_topic("words", partitions=2)
+    for i, w in enumerate(first):
+        broker.produce("words", w, partition=i % 2)
+
+    import os as _os
+
+    repo = _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__)))
+    env = dict(
+        os.environ,
+        PYTHONPATH=repo,
+        JAX_PLATFORMS="cpu",
+        PATHWAY_THREADS="4",
+        BROKER_PATH=broker_path,
+        PSTORE=pstore,
+        EXPECTED_WORDS=str(10**9),  # run 1 never stops on its own
+    )
+    p = subprocess.Popen(
+        [_sys.executable, str(script), out],
+        env=env,
+        stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT,
+        text=True,
+    )
+    # wait until a snapshot manifest covers all first-half events, then kill -9:
+    # the snapshot point is then exactly the first-half state (no in-flight
+    # suffix), so net(out1) + net(out2) must equal ground truth exactly
+    manifest_path = os.path.join(pstore, "operators", "manifest")
+    deadline = _time.time() + 90
+    while _time.time() < deadline:
+        if os.path.exists(manifest_path):
+            try:
+                with open(manifest_path, "rb") as fh:
+                    meta = pickle.loads(fh.read())
+                if meta["input_offsets"].get("words", 0) >= len(first):
+                    break
+            except Exception:
+                pass  # mid-replace read; retry
+        _time.sleep(0.05)
+    else:
+        p.kill()
+        raise AssertionError(
+            "no covering snapshot before deadline: " + (p.communicate()[0] or "")
+        )
+    p.send_signal(signal.SIGKILL)
+    p.wait()
+    import shutil
+
+    shutil.copy(out, out1)
+
+    # remaining input arrives while the pipeline is down
+    for i, w in enumerate(second):
+        broker.produce("words", w, partition=i % 2)
+
+    env["EXPECTED_WORDS"] = str(len(first) + len(second))
+    p = subprocess.Popen(
+        [_sys.executable, str(script), out],
+        env=env,
+        stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT,
+        text=True,
+    )
+    stdout, _ = p.communicate(timeout=120)
+    assert p.returncode == 0, stdout
+
+    truth: dict = {}
+    for w in first + second:
+        truth[w] = truth.get(w, 0) + 1
+    s1, s2 = _net_counts(out1), _net_counts(out)
+    combined = dict(s1)
+    for w, c in s2.items():
+        combined[w] = combined.get(w, 0) + c
+    assert combined == truth, (combined, truth)
+    # O(state): aggregates untouched since the snapshot are not re-emitted
+    assert not any(w.startswith("only") for w in s2), s2
 
 
 def test_operator_snapshot_join_state(tmp_path):
