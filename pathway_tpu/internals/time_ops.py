@@ -24,6 +24,7 @@ so simultaneous arrivals all see the pre-tick watermark.
 
 from __future__ import annotations
 
+import threading
 from typing import Any, Callable
 
 import numpy as np
@@ -33,27 +34,70 @@ from pathway_tpu.engine.graph import END_OF_STREAM, Node
 from pathway_tpu.internals.logical import LogicalNode
 
 
+class _SharedWatermark:
+    """One watermark cell shared by all worker shards of a temporal node.
+
+    The reference broadcasts the frontier to every worker over timely's
+    progress channels; here the logical node creates ONE of these at graph
+    definition time and every worker's node copy folds its local per-tick max
+    into it, so row state can shard by key while the watermark stays global.
+    (Thread-plane only: the multi-process cluster runtime routes
+    ``global_watermark`` nodes SOLO until cross-process watermark gossip
+    lands — see ``parallel/cluster.py``.)"""
+
+    __slots__ = ("lock", "watermark", "tick_max")
+
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.watermark: Any = None
+        self.tick_max: Any = None
+
+
 class _WatermarkNode(Node):
     """Shared machinery: evaluate threshold/current-time per row, keep watermark.
 
     The watermark starts as ``None`` (no data seen) rather than ``-inf`` so time
-    columns of any comparable dtype (ints, floats, datetime64) work."""
+    columns of any comparable dtype (ints, floats, datetime64) work.
 
-    def exchange_key(self, port):
-        from pathway_tpu.engine.graph import SOLO
+    Row state (held/live/frozen rows) is keyed by row key and shards across
+    workers with the default row-key exchange; only the watermark is global
+    (``_SharedWatermark``), which keeps sharded behavior bit-identical to the
+    serial node: a row's hold/release/drop decision depends only on (its
+    threshold, the global watermark)."""
 
-        return SOLO  # global-watermark / ordered state: serial on worker 0
+    #: multi-process runtimes without watermark gossip must run these serial
+    global_watermark = True
 
     def __init__(
         self,
         threshold_fn: Callable[[DeltaBatch], np.ndarray],
         current_time_fn: Callable[[DeltaBatch], np.ndarray],
+        shared: _SharedWatermark | None = None,
     ):
         super().__init__(n_inputs=1)
         self.threshold_fn = threshold_fn
         self.current_time_fn = current_time_fn
-        self.watermark: Any = None
-        self._tick_max: Any = None
+        self._shared = shared if shared is not None else _SharedWatermark()
+
+    # watermark/_tick_max live in the shared cell; exposed as attributes so
+    # snapshot_attrs (plain values) and existing call sites stay unchanged
+    @property
+    def watermark(self) -> Any:
+        return self._shared.watermark
+
+    @watermark.setter
+    def watermark(self, value: Any) -> None:
+        with self._shared.lock:
+            self._shared.watermark = value
+
+    @property
+    def _tick_max(self) -> Any:
+        return self._shared.tick_max
+
+    @_tick_max.setter
+    def _tick_max(self, value: Any) -> None:
+        with self._shared.lock:
+            self._shared.tick_max = value
 
     def _observe(self, batch: DeltaBatch) -> np.ndarray:
         """Track the batch's max current-time (applied to the watermark at frontier);
@@ -61,29 +105,36 @@ class _WatermarkNode(Node):
         cur = self.current_time_fn(batch)
         if len(cur):
             m = np.max(cur)
-            if self._tick_max is None or m > self._tick_max:
-                self._tick_max = m
+            with self._shared.lock:
+                if self._shared.tick_max is None or m > self._shared.tick_max:
+                    self._shared.tick_max = m
         return self.threshold_fn(batch)
 
     def _past(self, threshold: Any) -> bool:
         """Has the watermark passed this threshold?"""
-        return self.watermark is not None and threshold <= self.watermark
+        wm = self._shared.watermark
+        return wm is not None and threshold <= wm
 
     def _advance_watermark(self) -> None:
-        if self._tick_max is not None and (
-            self.watermark is None or self._tick_max > self.watermark
-        ):
-            self.watermark = self._tick_max
+        with self._shared.lock:
+            s = self._shared
+            if s.tick_max is not None and (
+                s.watermark is None or s.tick_max > s.watermark
+            ):
+                s.watermark = s.tick_max
 
 
 class BufferNode(_WatermarkNode):
     name = "buffer"
-    snapshot_attrs = ("watermark", "_tick_max", "_held")
+    snapshot_attrs = ("watermark", "_tick_max", "_held", "_columns")
 
-    def __init__(self, threshold_fn, current_time_fn):
-        super().__init__(threshold_fn, current_time_fn)
+    def __init__(self, threshold_fn, current_time_fn, shared=None):
+        super().__init__(threshold_fn, current_time_fn, shared)
         # key -> [threshold, values, net_diff]
         self._held: dict[int, list] = {}
+        # set on first batch; snapshotted so a restored shard can release its
+        # held rows even if the post-restart suffix never touches it
+        self._columns: list[str] | None = None
 
     def process(self, inputs, time):
         batch = inputs[0]
@@ -135,15 +186,13 @@ class BufferNode(_WatermarkNode):
 
     def on_frontier(self, time):
         self._advance_watermark()
-        if not self._held:
-            return []
         # column names aren't known until the first batch arrives
-        if not hasattr(self, "_columns"):
+        if not self._held or self._columns is None:
             return []
         return self._release(time)
 
     def accept(self, port, batch):
-        if not hasattr(self, "_columns"):
+        if self._columns is None:
             self._columns = list(batch.data.keys())
         super().accept(port, batch)
 
@@ -152,8 +201,8 @@ class ForgetNode(_WatermarkNode):
     name = "forget"
     snapshot_attrs = ("watermark", "_tick_max", "_live", "_columns")
 
-    def __init__(self, threshold_fn, current_time_fn, mark_forgetting_records=False):
-        super().__init__(threshold_fn, current_time_fn)
+    def __init__(self, threshold_fn, current_time_fn, mark_forgetting_records=False, shared=None):
+        super().__init__(threshold_fn, current_time_fn, shared)
         self.mark = mark_forgetting_records
         # key -> [threshold, values, net_diff] of rows currently downstream
         self._live: dict[int, list] = {}
@@ -207,8 +256,8 @@ class FreezeNode(_WatermarkNode):
     name = "freeze"
     snapshot_attrs = ("watermark", "_tick_max", "_frozen", "_pending_freeze")
 
-    def __init__(self, threshold_fn, current_time_fn):
-        super().__init__(threshold_fn, current_time_fn)
+    def __init__(self, threshold_fn, current_time_fn, shared=None):
+        super().__init__(threshold_fn, current_time_fn, shared)
         self._frozen: set[int] = set()
         # key -> threshold of rows passed but not yet frozen
         self._pending_freeze: dict[int, Any] = {}
@@ -242,9 +291,9 @@ class ForgetImmediatelyNode(Node):
     name = "forget_immediately"
 
     def exchange_key(self, port):
-        from pathway_tpu.engine.graph import SOLO
-
-        return SOLO  # global-watermark / ordered state: serial on worker 0
+        # no cross-row state at all: negate each tick's batches wherever they
+        # were produced — fully parallel
+        return None
 
     def __init__(self):
         super().__init__(n_inputs=1)
@@ -271,9 +320,20 @@ def _impl(table, threshold_column, current_time_column, node_cls, **kw):
 
     thr_fn = _compile_single(table._bind(threshold_column), table)
     cur_fn = _compile_single(table._bind(current_time_column), table)
-    node = LogicalNode(
-        lambda: node_cls(thr_fn, cur_fn, **kw), [table._node], name=node_cls.name
-    )
+    # one shared watermark cell per LOGICAL node: every worker's copy folds
+    # into it, so row state shards while the watermark stays global
+    shared = _SharedWatermark()
+
+    def make():
+        # builds happen before any processing (and before snapshot restore),
+        # so resetting here gives every RUN of this logical graph a fresh
+        # watermark — the cell outlives runs, its contents must not
+        with shared.lock:
+            shared.watermark = None
+            shared.tick_max = None
+        return node_cls(thr_fn, cur_fn, shared=shared, **kw)
+
+    node = LogicalNode(make, [table._node], name=node_cls.name)
     return Table(node, table._schema, table._universe.subset())
 
 

@@ -381,6 +381,11 @@ class ClusterRuntime:
             for ci, port in consumers:
                 consumer = lw.graph.nodes[ci]
                 key_fn = consumer.exchange_key(port)
+                if getattr(consumer, "global_watermark", False):
+                    # watermark nodes share a frontier cell across THREADS but
+                    # there is no cross-process watermark gossip yet: keep them
+                    # serial on the global worker 0 in cluster runs
+                    key_fn = SOLO
                 if key_fn is None:
                     consumer.accept(port, batch)
                 elif key_fn == SOLO:

@@ -10,8 +10,11 @@ lockstep. This module is the block-engine version:
 - At routing time, a consumer's :meth:`Node.exchange_key` decides placement:
   ``None`` → stay on the producing worker (stateless op); a key function →
   split the block by ``shard_of_keys`` and deliver each piece to its owner;
-  ``SOLO`` → everything to worker 0 (serial operators: sources, sinks,
-  global-watermark temporal ops, the external index).
+  ``SOLO`` → everything to worker 0 (serial operators: sources, sinks, sort's
+  global order, non-shardable external indexes). The temporal plane shards:
+  temporal/asof-now joins by join key, session windows by instance,
+  buffer/forget/freeze row state by row key with one shared watermark cell
+  per logical node (``internals/time_ops._SharedWatermark``).
 - Each tick runs sweep rounds: all workers sweep concurrently (threads), then
   meet at a barrier; the tick ends when a round does no work anywhere. The
   frontier phase runs the same way, so every worker passes timestamp t before

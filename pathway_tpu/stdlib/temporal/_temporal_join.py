@@ -285,9 +285,10 @@ class AsofNowJoinNode(Node):
     snapshot_attrs = ("right", "_right_by_jk", "_answered")
 
     def exchange_key(self, port):
-        from pathway_tpu.engine.graph import SOLO
-
-        return SOLO  # global-watermark / ordered state: serial on worker 0
+        # right state and query answering are both keyed by join key: shard by
+        # __jk__ like TemporalJoinNode (queries meet exactly the right-state
+        # shard they need; as-of-now answers are per-query-row)
+        return lambda batch: batch.data["__jk__"].astype(np.uint64)
 
     def __init__(self, n_left_cols: int, n_right_cols: int, how: str):
         super().__init__(n_inputs=2)
@@ -297,12 +298,15 @@ class AsofNowJoinNode(Node):
         self.right = _Side()
         self._right_by_jk: dict[Any, set[int]] = {}
         self._answered: dict[int, list[tuple[int, tuple]]] = {}  # lk -> emissions
+        self._pending: list[DeltaBatch] = []  # queries awaiting the frontier
 
     def process(self, inputs, time):
-        out_keys: list[int] = []
-        out_diffs: list[int] = []
-        out_rows: list[tuple] = []
-        # right updates FIRST: queries in the same tick see them (as-of-now)
+        # right updates apply immediately; queries BUFFER until the frontier.
+        # Under sharded sweeps a same-tick right update can arrive from
+        # another worker after the query batch — answering at the frontier
+        # (global quiescence) keeps "queries see every update of their tick"
+        # deterministic regardless of sweep interleaving (the serial engine's
+        # topo order gave this for free).
         if inputs[1] is not None:
             batch = inputs[1]
             jks = batch.data["__jk__"]
@@ -319,7 +323,17 @@ class AsofNowJoinNode(Node):
                     if info is not None:
                         self._right_by_jk.get(info[0], set()).discard(k)
         if inputs[0] is not None:
-            batch = inputs[0]
+            self._pending.append(inputs[0])
+        return []
+
+    def on_frontier(self, time):
+        if not self._pending:
+            return []
+        batches, self._pending = self._pending, []
+        out_keys: list[int] = []
+        out_diffs: list[int] = []
+        out_rows: list[tuple] = []
+        for batch in batches:
             jks = batch.data["__jk__"]
             val_cols = [batch.data[f"__v{i}"] for i in range(self.n_left_cols)]
             for i in range(len(batch)):

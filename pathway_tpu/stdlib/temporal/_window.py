@@ -201,9 +201,13 @@ class SessionAssignNode(Node):
     name = "session_assign"
 
     def exchange_key(self, port):
-        from pathway_tpu.engine.graph import SOLO
+        # session state is independent per instance: shard by instance hash
+        # (the reference keys its session arrangement the same way,
+        # time_column.rs) — one instance's rows always co-locate, so sharded
+        # runs are byte-identical to serial
+        from pathway_tpu.internals.keys import hash_column
 
-        return SOLO  # global-watermark / ordered state: serial on worker 0
+        return lambda batch: hash_column(batch.data["__inst"])
 
     def __init__(self, columns: list[str], predicate, max_gap):
         super().__init__(n_inputs=1)
