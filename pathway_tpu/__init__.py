@@ -135,8 +135,15 @@ def set_license_key(key: str | None) -> None:
     pass  # no license enforcement in the TPU build (reference: src/engine/license.rs)
 
 
-def set_monitoring_config(*, server_endpoint: str | None = None) -> None:
-    pass
+def set_monitoring_config(*, server_endpoint: str | None = None, **kwargs) -> None:
+    """Configure trace export. ``trace_file=...`` writes an OTLP/JSON trace
+    document per run (``internals/telemetry.py``); pass ``trace_file=None``
+    explicitly to clear it — calls setting only other knobs leave it alone.
+    ``server_endpoint`` (the reference's OTLP collector URL) is accepted but
+    inert on this zero-egress image."""
+    from pathway_tpu.internals import telemetry as _telemetry
+
+    _telemetry.set_monitoring_config(**kwargs)
 
 
 __all__ = [

@@ -72,20 +72,47 @@ class Node:
         self.stats_rows_in = 0
         self.stats_rows_out = 0
         self.stats_time_ns = 0
+        # per-operator probes (reference: Prober / OperatorStats{latency,lag},
+        # src/engine/dataflow.rs:678-806, graph.rs:497-527): queue latency =
+        # wall time a pending input set waited before this node drained it;
+        # last processed logical time feeds the lag computation in monitoring
+        self.stats_latency_ms = 0.0  # last drain
+        self.stats_latency_ewma_ms = 0.0
+        self.stats_last_time = -1
+        self._pending_since: int | None = None
 
     # -- scheduler interface --
     def accept(self, port: int, batch: DeltaBatch) -> None:
         if not batch.is_empty:
+            if self._pending_since is None:
+                self._pending_since = _time.perf_counter_ns()
             self._buffers[port].append(batch)
 
     def has_pending(self) -> bool:
         return any(self._buffers)
 
     def drain(self) -> list[DeltaBatch | None]:
+        if self._pending_since is not None:
+            lat = (_time.perf_counter_ns() - self._pending_since) / 1e6
+            self.stats_latency_ms = lat
+            self.stats_latency_ewma_ms = (
+                lat
+                if self.stats_latency_ewma_ms == 0.0
+                else 0.8 * self.stats_latency_ewma_ms + 0.2 * lat
+            )
+            self._pending_since = None
         out: list[DeltaBatch | None] = []
         for port in range(self.n_inputs):
             out.append(concat_batches(self._buffers[port]))
             self._buffers[port] = []
+        for b in out:
+            if (
+                b is not None
+                and b.time is not None
+                and b.time != END_OF_STREAM  # the close tick is not a logical time
+                and b.time > self.stats_last_time
+            ):
+                self.stats_last_time = b.time
         return out
 
     # -- operator interface --

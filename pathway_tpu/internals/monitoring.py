@@ -43,11 +43,24 @@ def scheduler_stats(scheduler) -> list[dict[str, Any]]:
                     "rows_in": 0,
                     "rows_out": 0,
                     "time_ms": 0.0,
+                    "latency_ms": 0.0,
+                    "last_time": -1,
                 }
             o["rows_in"] += node.stats_rows_in
             o["rows_out"] += node.stats_rows_out
             o["time_ms"] = round(o["time_ms"] + node.stats_time_ns / 1e6, 3)
-    return [agg[i] for i in sorted(agg)]
+            # worker shards: worst (max) queue latency, most advanced tick
+            o["latency_ms"] = round(
+                max(o["latency_ms"], node.stats_latency_ewma_ms), 3
+            )
+            o["last_time"] = max(o["last_time"], node.stats_last_time)
+    ops = [agg[i] for i in sorted(agg)]
+    # lag (reference OperatorStats.lag): logical ticks behind the
+    # most-advanced operator; operators that never saw data report no lag
+    frontier = max((o["last_time"] for o in ops), default=-1)
+    for o in ops:
+        o["lag"] = (frontier - o["last_time"]) if o["last_time"] >= 0 else None
+    return ops
 
 
 def run_stats(runtime) -> dict[str, Any]:
@@ -66,16 +79,20 @@ def prometheus_text(runtime) -> str:
     """Prometheus exposition format (``http_server.rs`` metric names adapted)."""
     stats = run_stats(runtime)
     metrics = [
-        ("pathway_operator_rows_in_total", "Rows consumed by an operator", "rows_in"),
-        ("pathway_operator_rows_out_total", "Rows emitted by an operator", "rows_out"),
-        ("pathway_operator_time_ms", "Time spent inside an operator", "time_ms"),
+        ("pathway_operator_rows_in_total", "Rows consumed by an operator", "rows_in", "counter"),
+        ("pathway_operator_rows_out_total", "Rows emitted by an operator", "rows_out", "counter"),
+        ("pathway_operator_time_ms", "Time spent inside an operator", "time_ms", "counter"),
+        ("pathway_operator_latency_ms", "Input queue latency (EWMA) of an operator", "latency_ms", "gauge"),
+        ("pathway_operator_lag", "Logical ticks behind the most-advanced operator", "lag", "gauge"),
     ]
     labels = [f'operator="{o["operator"]}",id="{o["id"]}"' for o in stats["operators"]]
     lines = []
-    for name, help_text, field in metrics:
+    for name, help_text, field, mtype in metrics:
         lines.append(f"# HELP {name} {help_text}")
-        lines.append(f"# TYPE {name} counter")
+        lines.append(f"# TYPE {name} {mtype}")
         for o, label in zip(stats["operators"], labels):
+            if o[field] is None:
+                continue
             lines.append(f"{name}{{{label}}} {o[field]}")
     return "\n".join(lines) + "\n"
 

@@ -131,6 +131,11 @@ def run(
         import threading as _threading
 
         outputs = list(G.outputs)
+        from pathway_tpu.internals import telemetry as _telemetry
+
+        import time as _time
+
+        t_start_ns = _time.time_ns()
 
         def _bg():
             try:
@@ -142,6 +147,18 @@ def run(
                 # stop()/join(), i.e. on the thread that owns the policy
                 if http_server is not None:
                     http_server.stop()
+                tf = _telemetry.trace_file()
+                if tf:
+                    try:
+                        _telemetry.export_run_trace(
+                            runtime, tf, t_start_ns, _time.time_ns()
+                        )
+                    except Exception:
+                        import logging
+
+                        logging.getLogger(__name__).warning(
+                            "trace export to %s failed", tf, exc_info=True
+                        )
 
         th = _threading.Thread(target=_bg, daemon=True)
         th.start()
@@ -154,12 +171,27 @@ def run(
 
         return _interactive.InteractiveRunHandle(runtime, th, on_finish=_restore)
 
+    import time as _time
+
+    from pathway_tpu.internals import telemetry as _telemetry
+
+    t_start_ns = _time.time_ns()
     try:
         runtime.run(list(G.outputs))
     finally:
         _errors.set_error_policy(prev_policy)
         if http_server is not None:
             http_server.stop()
+        tf = _telemetry.trace_file()
+        if tf:
+            try:
+                _telemetry.export_run_trace(runtime, tf, t_start_ns, _time.time_ns())
+            except Exception:
+                import logging
+
+                logging.getLogger(__name__).warning(
+                    "trace export to %s failed", tf, exc_info=True
+                )
         from pathway_tpu.internals.monitoring import print_summary
 
         level = monitoring_level if isinstance(monitoring_level, str) else "auto"

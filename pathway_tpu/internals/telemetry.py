@@ -1,0 +1,133 @@
+"""OTLP-shaped trace export (span-per-run + span-per-operator).
+
+Offline counterpart of the reference's OpenTelemetry pipeline
+(``src/engine/telemetry.rs:42-47`` builds OTLP trace+metrics exporters over
+tonic/gRPC; ``graph_runner/telemetry.py`` opens ``graph_runner.run`` spans with
+graph-statistics attributes). This image has zero egress, so instead of a
+collector endpoint the run writes one OTLP/JSON document
+(``ExportTraceServiceRequest`` shape — the same JSON an OTLP file exporter or
+``otlp-json`` collector receiver consumes) to a file:
+
+- root span ``pathway.run`` carrying run-level attributes (workers, operator
+  count, row totals),
+- one child span per operator with its rows/busy-time/latency/lag probes
+  (the ``OperatorStats`` analogue, ``src/engine/graph.rs:497-527``).
+
+Enable with ``pw.set_monitoring_config(trace_file=...)`` or
+``PATHWAY_TRACE_FILE=/path/run.otlp.json``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import secrets
+from typing import Any
+
+_UNSET = object()
+
+_trace_file_override: str | None = None
+
+
+def set_monitoring_config(*, trace_file: Any = _UNSET) -> None:
+    """Runtime override of the trace destination (reference:
+    ``pw.set_monitoring_config(monitoring_server=...)``). Only an explicitly
+    passed ``trace_file`` (including ``None`` to clear) changes the setting —
+    calls configuring other knobs leave it untouched."""
+    global _trace_file_override
+    if trace_file is not _UNSET:
+        _trace_file_override = trace_file
+
+
+def trace_file() -> str | None:
+    if _trace_file_override is not None:
+        return _trace_file_override
+    return os.environ.get("PATHWAY_TRACE_FILE") or None
+
+
+def _attr(key: str, value: Any) -> dict:
+    if isinstance(value, bool):
+        v = {"boolValue": value}
+    elif isinstance(value, int):
+        v = {"intValue": str(value)}
+    elif isinstance(value, float):
+        v = {"doubleValue": value}
+    else:
+        v = {"stringValue": str(value)}
+    return {"key": key, "value": v}
+
+
+def export_run_trace(
+    runtime, path: str, start_ns: int, end_ns: int
+) -> dict:
+    """Write one OTLP/JSON trace document for a finished (or stopping) run;
+    returns the document (tests introspect it)."""
+    from pathway_tpu.internals.monitoring import run_stats
+
+    stats = run_stats(runtime)
+    trace_id = secrets.token_hex(16)
+    root_id = secrets.token_hex(8)
+    spans = [
+        {
+            "traceId": trace_id,
+            "spanId": root_id,
+            "name": "pathway.run",
+            "kind": 1,  # SPAN_KIND_INTERNAL
+            "startTimeUnixNano": str(start_ns),
+            "endTimeUnixNano": str(end_ns),
+            "attributes": [
+                _attr("pathway.n_operators", len(stats["operators"])),
+                _attr("pathway.rows_in_total", stats["rows_in_total"]),
+                _attr("pathway.rows_out_total", stats["rows_out_total"]),
+                _attr(
+                    "pathway.n_workers",
+                    len(getattr(runtime, "workers", None) or []) or 1,
+                ),
+            ],
+        }
+    ]
+    for op in stats["operators"]:
+        attrs = [
+            _attr("pathway.operator.id", op["id"]),
+            _attr("pathway.operator.rows_in", op["rows_in"]),
+            _attr("pathway.operator.rows_out", op["rows_out"]),
+            _attr("pathway.operator.busy_ms", op["time_ms"]),
+            _attr("pathway.operator.latency_ms", op["latency_ms"]),
+        ]
+        if op.get("lag") is not None:
+            attrs.append(_attr("pathway.operator.lag", op["lag"]))
+        spans.append(
+            {
+                "traceId": trace_id,
+                "spanId": secrets.token_hex(8),
+                "parentSpanId": root_id,
+                "name": f"operator/{op['operator']}",
+                "kind": 1,
+                "startTimeUnixNano": str(start_ns),
+                "endTimeUnixNano": str(end_ns),
+                "attributes": attrs,
+            }
+        )
+    doc = {
+        "resourceSpans": [
+            {
+                "resource": {
+                    "attributes": [
+                        _attr("service.name", "pathway_tpu"),
+                        _attr("process.pid", os.getpid()),
+                    ]
+                },
+                "scopeSpans": [
+                    {
+                        "scope": {"name": "pathway_tpu.run", "version": "1"},
+                        "spans": spans,
+                    }
+                ],
+            }
+        ]
+    }
+    tmp = f"{path}.tmp"
+    with open(tmp, "w") as fh:
+        json.dump(doc, fh)
+    os.replace(tmp, path)
+    return doc

@@ -106,3 +106,101 @@ def test_console_summary_levels():
     assert print_summary(rt, "none") is None
     # auto on a non-tty stays silent
     assert print_summary(rt, "auto", file=io.StringIO()) is None
+
+
+# -------------------------------------------------- latency/lag probes + OTLP
+
+
+def _streaming_pipeline(collect):
+    """A multi-tick streaming run so latency probes actually populate."""
+    G.clear()
+
+    class Subj(pw.io.python.ConnectorSubject):
+        def run(self):
+            for i in range(60):
+                self.next(x=i)
+                if i % 20 == 19:
+                    time.sleep(0.02)
+
+    t = pw.io.python.read(Subj(), schema=S)
+    t = t.with_columns(m=t.x % 5)
+    g = t.groupby(t.m).reduce(s=pw.reducers.sum(t.x))
+    pw.io.subscribe(g, on_change=lambda key, row, time, is_addition: collect.append(row))
+
+
+def test_latency_and_lag_probes_populate_under_streaming():
+    """VERDICT r3 #6 done-criterion: per-operator latency/lag fields populate
+    under a streaming run (reference Prober/OperatorStats analogue)."""
+    rows: list = []
+    _streaming_pipeline(rows)
+    pw.run(monitoring_level="none")
+    from pathway_tpu.internals.monitoring import run_stats
+
+    stats = run_stats(pw.internals.run.current_runtime())
+    ops = {o["operator"]: o for o in stats["operators"]}
+    assert "groupby" in ops and "subscribe" in ops
+    worked = [o for o in stats["operators"] if o["rows_in"] > 0]
+    assert worked
+    # every operator that processed rows has a measured queue latency and
+    # a lag relative to the most-advanced operator
+    for o in worked:
+        assert o["latency_ms"] > 0, o
+        assert o["lag"] is not None and o["lag"] >= 0, o
+    # the stream spanned several ticks, so last_time must be past tick 0
+    assert max(o["last_time"] for o in worked) > 0
+
+
+def test_latency_in_prometheus_and_status():
+    rows: list = []
+    _streaming_pipeline(rows)
+    pw.run(monitoring_level="none")
+    from pathway_tpu.internals.monitoring import prometheus_text
+
+    text = prometheus_text(pw.internals.run.current_runtime())
+    assert "pathway_operator_latency_ms" in text
+    assert "pathway_operator_lag" in text
+    assert 'operator="groupby"' in text
+
+
+def test_otlp_trace_export(tmp_path):
+    """Span-per-run OTLP/JSON export: a root pathway.run span + one child span
+    per operator with probe attributes."""
+    import os
+
+    path = str(tmp_path / "run.otlp.json")
+    rows: list = []
+    _streaming_pipeline(rows)
+    os.environ["PATHWAY_TRACE_FILE"] = path
+    try:
+        pw.run(monitoring_level="none")
+    finally:
+        del os.environ["PATHWAY_TRACE_FILE"]
+    with open(path) as fh:
+        doc = json.load(fh)
+    scope = doc["resourceSpans"][0]["scopeSpans"][0]
+    spans = scope["spans"]
+    root = [s for s in spans if s["name"] == "pathway.run"]
+    assert len(root) == 1
+    children = [s for s in spans if s.get("parentSpanId") == root[0]["spanId"]]
+    names = {s["name"] for s in children}
+    assert "operator/groupby" in names and "operator/subscribe" in names
+    assert all(s["traceId"] == root[0]["traceId"] for s in spans)
+    assert int(root[0]["endTimeUnixNano"]) > int(root[0]["startTimeUnixNano"])
+    # operator spans carry the probe attributes
+    gb = next(s for s in children if s["name"] == "operator/groupby")
+    keys = {a["key"] for a in gb["attributes"]}
+    assert {"pathway.operator.rows_in", "pathway.operator.latency_ms"} <= keys
+
+
+def test_set_monitoring_config_trace_file(tmp_path):
+    path = str(tmp_path / "cfg.otlp.json")
+    rows: list = []
+    _streaming_pipeline(rows)
+    pw.set_monitoring_config(trace_file=path)
+    try:
+        pw.run(monitoring_level="none")
+    finally:
+        pw.set_monitoring_config(trace_file=None)
+    with open(path) as fh:
+        doc = json.load(fh)
+    assert doc["resourceSpans"]
