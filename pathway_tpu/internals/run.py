@@ -147,18 +147,7 @@ def run(
                 # stop()/join(), i.e. on the thread that owns the policy
                 if http_server is not None:
                     http_server.stop()
-                tf = _telemetry.trace_file()
-                if tf:
-                    try:
-                        _telemetry.export_run_trace(
-                            runtime, tf, t_start_ns, _time.time_ns()
-                        )
-                    except Exception:
-                        import logging
-
-                        logging.getLogger(__name__).warning(
-                            "trace export to %s failed", tf, exc_info=True
-                        )
+                _telemetry.maybe_export_run_trace(runtime, t_start_ns)
 
         th = _threading.Thread(target=_bg, daemon=True)
         th.start()
@@ -182,16 +171,7 @@ def run(
         _errors.set_error_policy(prev_policy)
         if http_server is not None:
             http_server.stop()
-        tf = _telemetry.trace_file()
-        if tf:
-            try:
-                _telemetry.export_run_trace(runtime, tf, t_start_ns, _time.time_ns())
-            except Exception:
-                import logging
-
-                logging.getLogger(__name__).warning(
-                    "trace export to %s failed", tf, exc_info=True
-                )
+        _telemetry.maybe_export_run_trace(runtime, t_start_ns)
         from pathway_tpu.internals.monitoring import print_summary
 
         level = monitoring_level if isinstance(monitoring_level, str) else "auto"

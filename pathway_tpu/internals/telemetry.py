@@ -25,24 +25,51 @@ import secrets
 from typing import Any
 
 _UNSET = object()
+_DISABLED = object()
 
-_trace_file_override: str | None = None
+_trace_file_override: Any = _UNSET
 
 
 def set_monitoring_config(*, trace_file: Any = _UNSET) -> None:
     """Runtime override of the trace destination (reference:
     ``pw.set_monitoring_config(monitoring_server=...)``). Only an explicitly
-    passed ``trace_file`` (including ``None`` to clear) changes the setting —
-    calls configuring other knobs leave it untouched."""
+    passed ``trace_file`` changes the setting — calls configuring other knobs
+    leave it untouched. An explicit ``trace_file=None`` DISABLES tracing even
+    when ``PATHWAY_TRACE_FILE`` is set in the environment."""
     global _trace_file_override
     if trace_file is not _UNSET:
-        _trace_file_override = trace_file
+        _trace_file_override = _DISABLED if trace_file is None else trace_file
 
 
 def trace_file() -> str | None:
-    if _trace_file_override is not None:
+    if _trace_file_override is _DISABLED:
+        return None
+    if _trace_file_override is not _UNSET:
         return _trace_file_override
     return os.environ.get("PATHWAY_TRACE_FILE") or None
+
+
+def maybe_export_run_trace(runtime, start_ns: int) -> None:
+    """Shared run-end hook (both the batch and interactive pw.run paths):
+    write the OTLP document if a destination is configured, never raise."""
+    import time as _time
+
+    path = trace_file()
+    if not path:
+        return
+    # multi-process cluster runs share one env: suffix by process id so ranks
+    # don't clobber one file (same collision rule as the monitoring HTTP port)
+    n_proc = int(os.environ.get("PATHWAY_PROCESSES", "1") or 1)
+    if n_proc > 1:
+        path = f"{path}.p{int(os.environ.get('PATHWAY_PROCESS_ID', '0') or 0)}"
+    try:
+        export_run_trace(runtime, path, start_ns, _time.time_ns())
+    except Exception:
+        import logging
+
+        logging.getLogger(__name__).warning(
+            "trace export to %s failed", path, exc_info=True
+        )
 
 
 def _attr(key: str, value: Any) -> dict:
@@ -126,7 +153,7 @@ def export_run_trace(
             }
         ]
     }
-    tmp = f"{path}.tmp"
+    tmp = f"{path}.tmp{os.getpid()}"
     with open(tmp, "w") as fh:
         json.dump(doc, fh)
     os.replace(tmp, path)

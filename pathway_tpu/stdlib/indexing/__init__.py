@@ -11,6 +11,7 @@ from pathway_tpu.stdlib.indexing.hybrid_index import HybridIndex
 from pathway_tpu.stdlib.indexing.nearest_neighbors import (
     BruteForceKnn,
     DistanceMetric,
+    IvfFlatKnn,
     LshKnn,
     UsearchKnn,
 )
@@ -18,6 +19,7 @@ from pathway_tpu.stdlib.indexing.retrievers import (
     AbstractRetrieverFactory,
     BruteForceKnnFactory,
     HybridIndexFactory,
+    IvfFlatKnnFactory,
     LshKnnFactory,
     TantivyBM25Factory,
     UsearchKnnFactory,
@@ -33,6 +35,8 @@ __all__ = [
     "HybridIndex",
     "HybridIndexFactory",
     "InnerIndex",
+    "IvfFlatKnn",
+    "IvfFlatKnnFactory",
     "LshKnn",
     "LshKnnFactory",
     "TantivyBM25",

@@ -99,5 +99,43 @@ class LshKnn(InnerIndex):
         )
 
 
+class IvfFlatKnn(InnerIndex):
+    """IVF-flat approximate KNN (the HNSW-class retriever; backend in
+    ``indexing/ivf.py``): k-means coarse quantizer + exact scoring inside the
+    ``nprobe`` nearest lists. Sub-linear search for big corpora with measured
+    recall@10 ≥ 0.95 vs brute force (``tests/test_ivf.py``)."""
+
+    def __init__(
+        self,
+        data_column: ColumnReference,
+        dimensions: int,
+        *,
+        metric: DistanceMetric | str = DistanceMetric.COS,
+        metadata_column: ColumnExpression | None = None,
+        embedder=None,
+        nlist: int | None = None,
+        nprobe: int | None = None,
+        min_train: int = 4096,
+    ):
+        from pathway_tpu.stdlib.indexing.ivf import IvfFlatBackend
+
+        metric_val = metric.value if isinstance(metric, DistanceMetric) else str(metric)
+        transform = _embedder_transform(embedder)
+        super().__init__(
+            data_column,
+            metadata_column=metadata_column,
+            backend_factory=lambda: IvfFlatBackend(
+                dimension=dimensions,
+                metric=metric_val,
+                nlist=nlist,
+                nprobe=nprobe,
+                min_train=min_train,
+            ),
+            item_transform=transform,
+        )
+        self.dimensions = dimensions
+        self.metric = metric_val
+
+
 class UsearchKnn(BruteForceKnn):
     """Reference API parity; served by the exact HBM backend (see module note)."""

@@ -16,6 +16,7 @@ from pathway_tpu.stdlib.indexing.hybrid_index import HybridIndex
 from pathway_tpu.stdlib.indexing.nearest_neighbors import (
     BruteForceKnn,
     DistanceMetric,
+    IvfFlatKnn,
     LshKnn,
     UsearchKnn,
 )
@@ -69,6 +70,28 @@ class LshKnnFactory(BruteForceKnnFactory):
 @dataclass
 class UsearchKnnFactory(BruteForceKnnFactory):
     _index_cls: type = UsearchKnn
+
+
+@dataclass
+class IvfFlatKnnFactory(BruteForceKnnFactory):
+    """IVF-flat retriever (HNSW-class approximate index, ``indexing/ivf.py``)."""
+
+    nlist: int | None = None
+    nprobe: int | None = None
+    min_train: int = 4096
+
+    def build_index(self, data_column, data_table, metadata_column=None) -> DataIndex:
+        inner = IvfFlatKnn(
+            data_column,
+            self._resolved_dimensions(),
+            metric=self.metric,
+            metadata_column=metadata_column,
+            embedder=self.embedder,
+            nlist=self.nlist,
+            nprobe=self.nprobe,
+            min_train=self.min_train,
+        )
+        return DataIndex(data_table, inner)
 
 
 @dataclass
