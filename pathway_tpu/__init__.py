@@ -81,6 +81,7 @@ from pathway_tpu.internals.udfs import (
 
 # run ------------------------------------------------------------------------
 from pathway_tpu.internals.run import MonitoringLevel, run, run_all
+from pathway_tpu.internals.exported import ExportedTable, export_table, import_table
 from pathway_tpu.internals.parse_graph import G
 
 # subpackages ----------------------------------------------------------------
@@ -160,6 +161,9 @@ __all__ = [
     "DateTimeUtc",
     "Duration",
     "MonitoringLevel",
+    "ExportedTable",
+    "export_table",
+    "import_table",
     "UDF",
     "BaseCustomAccumulator",
     "apply",

@@ -128,6 +128,11 @@ class Node:
         """Called when the frontier passes ``time`` (end of tick). May emit."""
         return []
 
+    def on_tick_complete(self, time: int) -> None:
+        """Called once per tick AFTER the frontier loop settles — everything
+        emitted at ``time`` has been routed. Side effects only (sinks,
+        callbacks); emissions are not possible here."""
+
     def on_end(self) -> None:
         """Stream closed — release resources, fire final callbacks."""
 
@@ -204,6 +209,8 @@ class Scheduler:
             if progressed:
                 while self._sweep(time):
                     pass
+        for node in self.graph.nodes:
+            _run_annotated(node, node.on_tick_complete, time)
         for cb in self.on_tick_done:
             cb(time)
 

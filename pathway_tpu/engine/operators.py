@@ -1175,7 +1175,13 @@ class JoinNode(Node):
 
 class SubscribeNode(Node):
     """``pw.io.subscribe`` (reference: ``io/_subscribe.py`` → ``subscribe_table``,
-    ``src/engine/graph.rs:543``)."""
+    ``src/engine/graph.rs:543``).
+
+    Callbacks fire once per logical time with the tick's emissions
+    CONSOLIDATED (net diffs per key+row), matching the reference's
+    ``BatchWrapper`` per-time delivery — intra-tick churn (e.g. an as-of-now
+    reply overwriting the query-tick padding, or a sweep-round partial that a
+    later round corrects) is invisible to user callbacks."""
 
     name = "subscribe"
 
@@ -1194,23 +1200,31 @@ class SubscribeNode(Node):
         self.on_change = on_change
         self.on_time_end = on_time_end
         self._on_end = on_end
-        self._saw_data_at: int | None = None
+        self._pending: list[DeltaBatch] = []
 
     def process(self, inputs, time):
         batch = inputs[0]
-        if batch is None:
-            return []
-        self._saw_data_at = time
-        if self.on_change is not None:
-            for key, diff, row in batch.rows():
-                row_dict = dict(zip(self.columns, row))
-                self.on_change(key=key, row=row_dict, time=time, is_addition=diff > 0)
+        if batch is not None:
+            self._pending.append(batch)
         return []
 
-    def on_frontier(self, time):
-        if self.on_time_end is not None and self._saw_data_at == time and time != END_OF_STREAM:
+    def on_tick_complete(self, time):
+        if not self._pending:
+            return
+        batches, self._pending = self._pending, []
+        merged = concat_batches(batches)
+        net = consolidate(merged) if merged is not None else None
+        fired = False
+        if net is not None and len(net):
+            fired = True
+            if self.on_change is not None:
+                for key, diff, row in net.rows():
+                    row_dict = dict(zip(self.columns, row))
+                    self.on_change(
+                        key=key, row=row_dict, time=time, is_addition=diff > 0
+                    )
+        if fired and self.on_time_end is not None and time != END_OF_STREAM:
             self.on_time_end(time)
-        return []
 
     def on_end(self):
         if self._on_end is not None:

@@ -28,6 +28,18 @@ class ConnectorDriver(Protocol):
     def stop(self) -> None: ...
 
 
+def check_connector_failures(connectors) -> None:
+    """Surface captured connector-thread exceptions in the run loop (the
+    reference's ErrorReporter channel → driver abort, SURVEY §5.3)."""
+    for d in connectors:
+        fail = getattr(d, "failure", None)
+        if fail is None:
+            continue
+        e = fail()
+        if e is not None:
+            raise RuntimeError(f"input connector failed: {e!r}") from e
+
+
 class Runtime:
     def __init__(
         self,
@@ -77,6 +89,7 @@ class Runtime:
                 t0 = _time.perf_counter()
                 scheduler.run_tick(tick)
                 tick += 1
+                check_connector_failures(self.connectors)
                 if all(d.is_finished() for d in self.connectors):
                     scheduler.run_tick(tick)  # drain any final events
                     break

@@ -138,9 +138,15 @@ def run(
         t_start_ns = _time.time_ns()
 
         def _bg():
+            ok = False
             try:
                 runtime.run(outputs)
+                ok = True
             finally:
+                if not ok:
+                    from pathway_tpu.internals.exported import fail_close_exports
+
+                    fail_close_exports(runtime)
                 # the error policy is NOT restored here (restoring a
                 # process-global from a daemon thread would race a later
                 # pw.run on the main thread) — the handle restores it from
@@ -165,9 +171,15 @@ def run(
     from pathway_tpu.internals import telemetry as _telemetry
 
     t_start_ns = _time.time_ns()
+    ok = False
     try:
         runtime.run(list(G.outputs))
+        ok = True
     finally:
+        if not ok:
+            from pathway_tpu.internals.exported import fail_close_exports
+
+            fail_close_exports(runtime)
         _errors.set_error_policy(prev_policy)
         if http_server is not None:
             http_server.stop()

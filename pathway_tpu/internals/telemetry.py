@@ -57,12 +57,14 @@ def maybe_export_run_trace(runtime, start_ns: int) -> None:
     path = trace_file()
     if not path:
         return
-    # multi-process cluster runs share one env: suffix by process id so ranks
-    # don't clobber one file (same collision rule as the monitoring HTTP port)
-    n_proc = int(os.environ.get("PATHWAY_PROCESSES", "1") or 1)
-    if n_proc > 1:
-        path = f"{path}.p{int(os.environ.get('PATHWAY_PROCESS_ID', '0') or 0)}"
     try:
+        from pathway_tpu.internals.config import get_pathway_config
+
+        cfg = get_pathway_config()
+        # multi-process cluster runs share one env: suffix by process id so
+        # ranks don't clobber one file (same rule as the monitoring HTTP port)
+        if cfg.processes > 1:
+            path = f"{path}.p{cfg.process_id}"
         export_run_trace(runtime, path, start_ns, _time.time_ns())
     except Exception:
         import logging

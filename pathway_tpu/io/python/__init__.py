@@ -113,23 +113,34 @@ class ConnectorSubject:
 
 class _SubjectDriver:
     """Runs the subject's ``run()`` in a thread (reference: connector thread per
-    input, ``src/connectors/mod.rs:91``)."""
+    input, ``src/connectors/mod.rs:91``). A subject exception is captured and
+    surfaced by the runtime's main loop (the reference's ErrorReporter channel,
+    SURVEY §5.3) instead of dying silently with the thread."""
 
     virtual = False
 
     def __init__(self, subject: ConnectorSubject):
         self.subject = subject
         self.thread: threading.Thread | None = None
+        self.error: BaseException | None = None
+        self._stopped = False
 
     def start(self) -> None:
         def target() -> None:
             try:
                 self.subject.run()
+            except BaseException as e:  # noqa: BLE001 — transported to the run loop
+                self.error = e
             finally:
                 self.subject.close()
 
         self.thread = threading.Thread(target=target, daemon=True)
         self.thread.start()
+
+    def failure(self) -> BaseException | None:
+        # errors after a requested stop (e.g. a socket torn down mid-read)
+        # are shutdown noise, not pipeline failures
+        return None if self._stopped else self.error
 
     def is_finished(self) -> bool:
         node = self.subject._node
@@ -140,6 +151,7 @@ class _SubjectDriver:
         )
 
     def stop(self) -> None:
+        self._stopped = True
         self.subject.on_stop()
 
 

@@ -494,6 +494,9 @@ class ClusterRuntime:
             if not decision["again"]:
                 break
             self._round_until_quiescent(time, "sweep")
+        for lw in self.local_workers.values():
+            for node in lw.graph.nodes:
+                run_annotated(node, node.on_tick_complete, time)
         for cb in self.on_tick_done:
             cb(time)
 
@@ -518,6 +521,10 @@ class ClusterRuntime:
                 t0 = _time.perf_counter()
                 self.run_tick(tick)
                 tick += 1
+                if self.pid == 0:
+                    from pathway_tpu.engine.runtime import check_connector_failures
+
+                    check_connector_failures(self.connectors)
                 # process 0 decides continuation (it owns the sources)
                 if self.pid == 0:
                     done = (

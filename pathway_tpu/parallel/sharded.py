@@ -201,6 +201,9 @@ class ShardedRuntime:
             if progressed:
                 while any(self._parallel(lambda w: self._sweep_worker(w, time))):
                     pass
+        for w in self.workers:
+            for node in w.graph.nodes:
+                run_annotated(node, node.on_tick_complete, time)
         for cb in self.on_tick_done:
             cb(time)
 
@@ -226,6 +229,9 @@ class ShardedRuntime:
                 t0 = _time.perf_counter()
                 self.run_tick(tick)
                 tick += 1
+                from pathway_tpu.engine.runtime import check_connector_failures
+
+                check_connector_failures(self.connectors)
                 if all(d.is_finished() for d in self.connectors):
                     self.run_tick(tick)
                     break

@@ -182,13 +182,21 @@ class IvfFlatBackend(IndexBackend):
             self._csr_dirty = True
 
     # ------------------------------------------------------------------ training
+    def _pairwise(self, a: np.ndarray, b: np.ndarray) -> np.ndarray:
+        """(len_a, len_b) similarity matrix under the index metric (higher =
+        closer) — the ONE scoring formula (assignment, probing, tail, train
+        all route here so the metric cannot drift between paths)."""
+        if self.metric == "l2sq":
+            return (
+                2.0 * (a @ b.T)
+                - (a * a).sum(axis=1)[:, None]
+                - (b * b).sum(axis=1)[None, :]  # true -||a-b||^2
+            )
+        return a @ b.T
+
     def _centroid_scores(self, q: np.ndarray) -> np.ndarray:
         """(q, nlist) similarity of queries to centroids (higher = closer)."""
-        c = self._centroids
-        if self.metric == "l2sq":
-            # -||q-c||^2 up to a per-query constant
-            return 2.0 * q @ c.T - (c * c).sum(axis=1)[None, :]
-        return q @ c.T
+        return self._pairwise(q, self._centroids)
 
     def _train(self) -> None:
         """Vectorized Lloyd's k-means (few iterations; subsampled)."""
@@ -204,11 +212,7 @@ class IvfFlatBackend(IndexBackend):
         else:
             cents = x[rng.choice(len(x), nlist, replace=False)].copy()
             for _ in range(8):
-                if self.metric == "l2sq":
-                    scores = 2.0 * x @ cents.T - (cents * cents).sum(axis=1)[None, :]
-                else:
-                    scores = x @ cents.T
-                a = np.argmax(scores, axis=1)
+                a = np.argmax(self._pairwise(x, cents), axis=1)
                 counts = np.bincount(a, minlength=len(cents)).astype(np.float32)
                 sums = np.zeros_like(cents)
                 np.add.at(sums, a, x)
@@ -261,11 +265,7 @@ class IvfFlatBackend(IndexBackend):
 
     # ------------------------------------------------------------------ search
     def _score(self, q: np.ndarray, slots: np.ndarray) -> np.ndarray:
-        x = self._vecs[slots]
-        if self.metric == "l2sq":
-            d = x - q[None, :]
-            return -(d * d).sum(axis=1)
-        return x @ q
+        return self._pairwise(self._vecs[slots], q[None, :])[:, 0]
 
     def _top(self, q, slots, k, flt):
         scores = self._score(q, slots)
@@ -315,15 +315,7 @@ class IvfFlatBackend(IndexBackend):
             if s == e:
                 continue
             block = self._vecs_csr[s:e]
-            if self.metric == "l2sq":
-                sub = qs[q_idx]
-                scores = (
-                    2.0 * (block @ sub.T)
-                    - (block * block).sum(axis=1)[:, None]
-                    - (sub * sub).sum(axis=1)[None, :]  # true -||x-q||^2
-                )
-            else:
-                scores = block @ qs[q_idx].T  # (len, |q_idx|)
+            scores = self._pairwise(block, qs[q_idx])  # (len, |q_idx|)
             dead = ~self._csr_alive[s:e]
             if dead.any():  # rows removed since the last CSR rebuild
                 scores[dead] = -np.inf
@@ -344,14 +336,7 @@ class IvfFlatBackend(IndexBackend):
         if tail:
             tslots = np.asarray(tail, dtype=np.int64)
             tblock = self._vecs[tslots]
-            if self.metric == "l2sq":
-                tail_scores = (
-                    2.0 * (tblock @ qs.T)
-                    - (tblock * tblock).sum(axis=1)[:, None]
-                    - (qs * qs).sum(axis=1)[None, :]
-                )
-            else:
-                tail_scores = tblock @ qs.T
+            tail_scores = self._pairwise(tblock, qs)
             tail_keys = self._keys[tslots]
             tail_ties = tie_order_u64(tail_keys)
         out = []

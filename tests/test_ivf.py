@@ -27,9 +27,6 @@ def _brute_topk(x, keys, q, k, metric="cos"):
     return [int(keys[i]) for i in idx]
 
 
-_PASS = staticmethod(lambda meta: True)
-
-
 def _always(meta):
     return True
 
@@ -198,3 +195,47 @@ def test_streaming_churn_bounded_and_correct():
     be.remove(7)
     (res,) = be.search([vecs[7]], [3], [_always])
     assert all(key != 7 for key, _ in res)
+
+
+def test_as_of_now_answers_emit_once():
+    """Task: as-of-now query answers must be single, final emissions — no
+    visible pad-then-correct churn (subscribe delivers per-time consolidated
+    batches, reference BatchWrapper semantics)."""
+    import time as _t
+
+    G.clear()
+    rng = np.random.default_rng(8)
+    d = 8
+    docvecs = rng.standard_normal((50, d)).astype(np.float32)
+
+    class Docs(pw.io.python.ConnectorSubject):
+        def run(self):
+            for i in range(50):
+                self.next(doc=f"doc{i}", vec=docvecs[i])
+
+    class Queries(pw.io.python.ConnectorSubject):
+        def run(self):
+            _t.sleep(0.4)
+            self.next(qvec=docvecs[7] + 0.001)
+
+    from pathway_tpu.stdlib.indexing import BruteForceKnnFactory
+
+    docs = pw.io.python.read(
+        Docs(), schema=pw.schema_from_types(doc=str, vec=np.ndarray)
+    )
+    queries = pw.io.python.read(
+        Queries(), schema=pw.schema_from_types(qvec=np.ndarray)
+    )
+    index = BruteForceKnnFactory(dimensions=d).build_index(docs.vec, docs)
+    res = index.query_as_of_now(queries.qvec, number_of_matches=1).select(
+        doc=pw.right.doc
+    )
+    events = []
+    pw.io.subscribe(
+        res,
+        on_change=lambda key, row, time, is_addition: events.append(
+            (row["doc"], is_addition)
+        ),
+    )
+    pw.run(monitoring_level="none")
+    assert events == [(("doc7",), True)], events
