@@ -120,12 +120,132 @@ class MockKafkaBroker:
             return list(msgs[offset:])
 
 
-def _require_real_client(settings: dict) -> None:
-    raise NotImplementedError(
-        "real Kafka requires the confluent-kafka or kafka-python client, which is "
-        "not available in this environment; pass a MockKafkaBroker (optionally "
-        "file-backed) instead"
-    )
+def _client_module(settings: dict):
+    """Resolve a confluent-kafka-shaped client module for ``rdkafka_settings``
+    dicts: the ``client_factory`` key injects any object exposing
+    ``Consumer``/``Producer``/``TopicPartition`` (how CI exercises the real
+    wire path on this clientless image — ``tests/test_gated_connectors.py``);
+    otherwise ``confluent_kafka`` is imported."""
+    factory = settings.get("client_factory")
+    if factory is not None:
+        return factory
+    try:
+        import confluent_kafka
+
+        return confluent_kafka
+    except ImportError:
+        raise NotImplementedError(
+            "real Kafka requires the confluent-kafka client (or a "
+            "client_factory= entry in the settings dict); pass a "
+            "MockKafkaBroker (optionally file-backed) instead"
+        ) from None
+
+
+def _conf_of(settings: dict) -> dict:
+    conf = {k: v for k, v in settings.items() if k != "client_factory"}
+    conf.setdefault("group.id", "pathway")
+    return conf
+
+
+def _read_real(
+    settings: dict,
+    topic: str,
+    schema,
+    the_parser: Parser,
+    mode: str,
+    partitions: list[int] | None,
+    poll_interval: float,
+    name: str | None,
+):
+    """Consumer-driven read over the wire protocol client (reference
+    ``KafkaReader``, ``src/connectors/data_storage.rs:712``): assigned
+    partitions, per-partition offsets for the persistence seek contract,
+    static mode bounded by the watermark offsets captured at start."""
+    from pathway_tpu.io.python import ConnectorSubject, read as py_read
+
+    ck = _client_module(settings)
+
+    class _RealKafkaSubject(ConnectorSubject):
+        def __init__(self) -> None:
+            super().__init__()
+            self._stop = False
+            self._offsets: dict[int, int] = {}
+            self.sync_lock = threading.Lock()
+
+        def run(self) -> None:
+            consumer = ck.Consumer(_conf_of(settings))
+            try:
+                md = consumer.list_topics(topic)
+                parts = (
+                    partitions
+                    if partitions is not None
+                    else sorted(md.topics[topic].partitions.keys())
+                )
+                # fresh partitions start at OFFSET_BEGINNING (an absolute 0
+                # can be out of retention range and silently jump to the log
+                # end via auto.offset.reset)
+                begin = getattr(ck, "OFFSET_BEGINNING", 0)
+                consumer.assign(
+                    [
+                        ck.TopicPartition(topic, p, self._offsets.get(p, begin))
+                        for p in parts
+                    ]
+                )
+                ends: dict[int, int] | None = None
+                if mode == "static":
+                    ends = {}
+                    for p in parts:
+                        _lo, hi = consumer.get_watermark_offsets(
+                            ck.TopicPartition(topic, p)
+                        )
+                        ends[p] = hi
+                while not self._stop:
+                    msg = consumer.poll(poll_interval)
+                    if msg is None:
+                        if ends is not None and all(
+                            self._offsets.get(p, 0) >= ends[p] for p in parts
+                        ):
+                            return
+                        continue
+                    err = msg.error()
+                    if err is not None:
+                        # partition-EOF events are benign position markers;
+                        # anything else (auth, unknown topic, broker down) must
+                        # surface through the connector error channel, not spin
+                        eof = getattr(
+                            getattr(ck, "KafkaError", None), "_PARTITION_EOF", None
+                        )
+                        if eof is not None and getattr(err, "code", lambda: None)() == eof:
+                            continue
+                        raise RuntimeError(f"kafka consumer error: {err}")
+                    with self.sync_lock:
+                        for ev in the_parser.parse(
+                            RawMessage(
+                                value=msg.value(),
+                                key=msg.key(),
+                                metadata={"partition": msg.partition()},
+                            )
+                        ):
+                            self._push(ev.values, diff=ev.diff)
+                        self._offsets[msg.partition()] = msg.offset() + 1
+                    if ends is not None and all(
+                        self._offsets.get(p, 0) >= ends[p] for p in parts
+                    ):
+                        return
+            finally:
+                consumer.close()
+
+        # persistence contract (OffsetAntichain analogue + Reader::seek)
+        def offset_state(self) -> dict[int, int]:
+            return dict(self._offsets)
+
+        def seek(self, state: dict[int, int]) -> None:
+            self._offsets = {int(k): int(v) for k, v in state.items()}
+
+        def on_stop(self) -> None:
+            self._stop = True
+
+    return py_read(_RealKafkaSubject(), schema=schema, name=name or f"kafka:{topic}")
 
 
 def read(
@@ -144,8 +264,6 @@ def read(
 ) -> Table:
     """Consume ``topic`` into a table. ``mode="static"`` drains the current log
     then finishes; ``"streaming"`` keeps tailing until the run is stopped."""
-    if isinstance(broker, dict):
-        _require_real_client(broker)
     if schema is None:
         if format in ("plaintext", "raw"):
             schema = schema_mod.schema_from_types(data=str)
@@ -154,6 +272,10 @@ def read(
         else:
             raise ValueError("schema required for json/csv kafka formats")
     the_parser = parser or parser_for(format, schema)
+    if isinstance(broker, dict):
+        return _read_real(
+            broker, topic, schema, the_parser, mode, partitions, poll_interval, name
+        )
 
     from pathway_tpu.io.python import ConnectorSubject, read as py_read
 
@@ -217,21 +339,33 @@ def write(
     **kwargs: Any,
 ) -> None:
     """Produce every output diff of ``table`` to ``topic``."""
-    if isinstance(broker, dict):
-        _require_real_client(broker)
     from pathway_tpu.engine import operators as ops
     from pathway_tpu.internals.logical import LogicalNode
 
     cols = table.column_names()
     fmt = formatter or formatter_for(format, cols, **kwargs)
     key_idx = cols.index(key_column) if key_column else None
-    broker.create_topic(topic, 1)
 
-    def on_batch(batch, columns) -> None:
-        for key, diff, row in batch.rows():
-            payload = fmt.format(int(key), row, batch.time, diff)
-            mkey = str(row[key_idx]) if key_idx is not None else None
-            broker.produce(topic, payload, key=mkey)
+    if isinstance(broker, dict):
+        # wire-protocol producer (reference KafkaWriter, data_storage.rs:1406)
+        ck = _client_module(broker)
+        producer = ck.Producer(_conf_of(broker))
+
+        def on_batch(batch, columns) -> None:
+            for key, diff, row in batch.rows():
+                payload = fmt.format(int(key), row, batch.time, diff)
+                mkey = str(row[key_idx]) if key_idx is not None else None
+                producer.produce(topic, value=payload, key=mkey)
+            producer.flush()
+
+    else:
+        broker.create_topic(topic, 1)
+
+        def on_batch(batch, columns) -> None:
+            for key, diff, row in batch.rows():
+                payload = fmt.format(int(key), row, batch.time, diff)
+                mkey = str(row[key_idx]) if key_idx is not None else None
+                broker.produce(topic, payload, key=mkey)
 
     LogicalNode(
         lambda: ops.CallbackOutputNode(cols, on_batch),

@@ -15,12 +15,16 @@ from pathway_tpu.internals.table import Table
 
 
 def _connect(settings: dict):
+    # DI hook: a pre-built DBAPI connection (how CI exercises the write paths
+    # on this driverless image — tests/test_gated_connectors.py)
+    if "connection" in settings:
+        return settings["connection"]
     try:
         import psycopg2  # noqa: F401
     except ImportError:
         raise NotImplementedError(
-            "pw.io.postgres requires psycopg2, which is not available in this "
-            "environment"
+            "pw.io.postgres requires psycopg2 (or a pre-built connection= in "
+            "the settings dict), which is not available in this environment"
         ) from None
     import psycopg2
 

@@ -1,5 +1,13 @@
-"""Gated connector: reference `python/pathway/io/s3_csv`. See _gated.py."""
+"""CSV-over-S3 (reference ``python/pathway/io/s3_csv``): ``pw.io.s3.read``
+with ``format="csv"`` pre-bound."""
 
-from pathway_tpu.io._gated import gate
+from __future__ import annotations
 
-read = gate("s3_csv", "boto3 and object-store access")
+from typing import Any
+
+from pathway_tpu.io import s3 as _s3
+
+
+def read(path: str, aws_s3_settings: Any = None, **kwargs: Any):
+    kwargs.setdefault("format", "csv")
+    return _s3.read(path, aws_s3_settings, **kwargs)

@@ -23,7 +23,10 @@ class Backend:
 
     @classmethod
     def s3(cls, root_path: str, bucket_settings: Any = None) -> "Backend":
-        raise NotImplementedError("s3 persistence backend requires object-store access")
+        """Object-store persistence (reference ``backends/s3.rs``).
+        ``bucket_settings`` is ``pw.io.s3.AwsS3Settings``; its ``client=``
+        hook injects any boto3-shaped object where boto3 itself is absent."""
+        return cls("s3", root_path, bucket_settings=bucket_settings)
 
     @classmethod
     def mock(cls, events: Any = None) -> "Backend":

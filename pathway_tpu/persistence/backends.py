@@ -122,6 +122,60 @@ class MockBackend(MemoryBackend):
         return super().get(key)
 
 
+class S3Backend(KVBackend):
+    """Object-store KV (reference ``src/persistence/backends/s3.rs``): one
+    object per key under a root prefix, over a boto3-style client (injectable
+    — this image has neither boto3 nor egress, so CI drives this against a
+    dict-backed fake; see ``tests/test_gated_connectors.py``)."""
+
+    def __init__(self, client, bucket: str, root: str):
+        self.client = client
+        self.bucket = bucket
+        self.root = root.strip("/")
+
+    def _key(self, key: str) -> str:
+        return f"{self.root}/{key}" if self.root else key
+
+    def put(self, key: str, value: bytes) -> None:
+        self.client.put_object(Bucket=self.bucket, Key=self._key(key), Body=value)
+
+    def get(self, key: str) -> bytes | None:
+        try:
+            resp = self.client.get_object(Bucket=self.bucket, Key=self._key(key))
+        except Exception as e:
+            # ONLY a genuinely-missing key maps to None — an AccessDenied /
+            # throttle / 500 must surface, not silently restart the pipeline
+            # from empty state
+            if type(e).__name__ == "NoSuchKey":
+                return None
+            code = (
+                getattr(e, "response", None) or {}
+            ).get("Error", {}).get("Code")
+            if code in ("NoSuchKey", "404"):
+                return None
+            raise
+        return resp["Body"].read()
+
+    def list_keys(self, prefix: str = "") -> list[str]:
+        out = []
+        token = None
+        full = self._key(prefix)
+        while True:
+            kw = {"Bucket": self.bucket, "Prefix": full}
+            if token:
+                kw["ContinuationToken"] = token
+            resp = self.client.list_objects_v2(**kw)
+            strip = len(self.root) + 1 if self.root else 0
+            out.extend(obj["Key"][strip:] for obj in resp.get("Contents", []))
+            if not resp.get("IsTruncated"):
+                break
+            token = resp.get("NextContinuationToken")
+        return sorted(out)
+
+    def delete(self, key: str) -> None:
+        self.client.delete_object(Bucket=self.bucket, Key=self._key(key))
+
+
 def backend_from_config(backend) -> KVBackend:
     if backend.kind == "filesystem":
         return FileBackend(backend.path)
@@ -129,4 +183,11 @@ def backend_from_config(backend) -> KVBackend:
         return MemoryBackend(backend.path or "default")
     if backend.kind == "mock":
         return MockBackend(backend.path or "mock")
+    if backend.kind == "s3":
+        from pathway_tpu.io.s3 import _make_client, _split_path
+
+        settings = backend.extra.get("bucket_settings")
+        client = _make_client(settings)
+        bucket, prefix = _split_path(backend.path or "", settings)
+        return S3Backend(client, bucket, prefix)
     raise ValueError(f"unknown persistence backend kind {backend.kind!r}")

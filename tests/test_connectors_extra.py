@@ -88,9 +88,19 @@ def test_debezium_module_roundtrip():
 
 
 def test_gated_connectors_raise_clearly():
+    # s3/minio now implement real logic and gate only on the missing client
     with pytest.raises(NotImplementedError, match="boto3"):
-        pw.io.minio.read("x")
+        pw.io.s3.read(
+            "s3://b/x", format="plaintext", mode="static"
+        )  # no boto3, no injected client
     with pytest.raises(NotImplementedError, match="deltalake"):
         pw.io.deltalake.write(None, "p")
     with pytest.raises(NotImplementedError, match="psycopg2"):
         pw.io.postgres.write(None, {}, "t")
+    with pytest.raises(NotImplementedError, match="confluent-kafka"):
+        pw.io.kafka.read(
+            {"bootstrap.servers": "x:9092"},
+            "t",
+            schema=pw.schema_from_types(v=int),
+            format="json",
+        )
