@@ -157,6 +157,45 @@ class TransparentParser(Parser):
         return [ParsedEvent(tuple(message.value))]
 
 
+def rows_from_bytes(data: bytes, fmt: str, schema) -> list[tuple]:
+    """Decode one whole payload (file / object) into schema-ordered rows —
+    the ONE per-format recipe shared by the fs and s3 connectors so format
+    semantics (csv coercion, JSON wrapping) cannot drift between them."""
+    cols = schema.column_names()
+    dtypes = schema.dtypes()
+    if fmt == "binary":
+        return [(data,)]
+    text = data.decode(errors="replace")
+    if fmt in ("plaintext_by_file", "plaintext_by_object"):
+        return [(text,)]
+    if fmt == "plaintext":
+        return [(line,) for line in text.splitlines()]
+    if fmt == "csv":
+        rows = []
+        for rec in _csv.DictReader(_io.StringIO(text)):
+            rows.append(tuple(coerce_scalar(rec.get(c, ""), dtypes[c]) for c in cols))
+        return rows
+    if fmt in ("json", "jsonlines"):
+        from pathway_tpu.internals.json import Json
+
+        rows = []
+        for line in text.splitlines():
+            line = line.strip()
+            if not line:
+                continue
+            rec = _json.loads(line)
+            row = []
+            for c in cols:
+                v = rec.get(c)
+                d = dt.unoptionalize(dtypes[c])
+                if d == dt.JSON and not isinstance(v, Json):
+                    v = Json(v)
+                row.append(v)
+            rows.append(tuple(row))
+        return rows
+    raise ValueError(f"unknown format {fmt!r}")
+
+
 class DebeziumMessageParser(Parser):
     """CDC envelopes: ``{"payload": {"op": c|r|u|d, "before": …, "after": …}}``
     (reference ``DebeziumMessageParser:1433``, standard + MongoDB dialects)."""

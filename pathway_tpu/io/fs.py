@@ -45,42 +45,10 @@ from pathway_tpu.io._format import coerce_scalar as _coerce  # shared Parser-lay
 def _parse_file(
     fpath: str, fmt: str, schema: schema_mod.SchemaMetaclass, csv_settings: Any = None
 ) -> list[tuple]:
-    cols = schema.column_names()
-    dtypes = schema.dtypes()
-    rows: list[tuple] = []
-    if fmt in ("plaintext", "plaintext_by_file"):
-        with open(fpath, "r", errors="replace") as f:
-            if fmt == "plaintext_by_file":
-                return [(f.read(),)]
-            return [(line.rstrip("\n"),) for line in f]
-    if fmt == "binary":
-        with open(fpath, "rb") as f:
-            return [(f.read(),)]
-    if fmt == "csv":
-        with open(fpath, "r", newline="", errors="replace") as f:
-            reader = _csv.DictReader(f)
-            for rec in reader:
-                rows.append(tuple(_coerce(rec.get(c, ""), dtypes[c]) for c in cols))
-        return rows
-    if fmt in ("json", "jsonlines"):
-        from pathway_tpu.internals.json import Json
+    from pathway_tpu.io._format import rows_from_bytes
 
-        with open(fpath, "r", errors="replace") as f:
-            for line in f:
-                line = line.strip()
-                if not line:
-                    continue
-                rec = _json.loads(line)
-                row = []
-                for c in cols:
-                    v = rec.get(c)
-                    d = dt.unoptionalize(dtypes[c])
-                    if d == dt.JSON and not isinstance(v, Json):
-                        v = Json(v)
-                    row.append(v)
-                rows.append(tuple(row))
-        return rows
-    raise ValueError(f"unknown format {fmt!r}")
+    with open(fpath, "rb") as f:
+        return rows_from_bytes(f.read(), fmt, schema)
 
 
 def _keys_for(

@@ -176,10 +176,17 @@ def _read_real(
             consumer = ck.Consumer(_conf_of(settings))
             try:
                 md = consumer.list_topics(topic)
+                tmeta = md.topics.get(topic) if hasattr(md.topics, "get") else None
+                terr = getattr(tmeta, "error", None)
+                if tmeta is None or terr or not getattr(tmeta, "partitions", None):
+                    raise RuntimeError(
+                        f"kafka topic {topic!r} unavailable: "
+                        f"{terr or 'unknown topic / no partitions'}"
+                    )
                 parts = (
                     partitions
                     if partitions is not None
-                    else sorted(md.topics[topic].partitions.keys())
+                    else sorted(tmeta.partitions.keys())
                 )
                 # fresh partitions start at OFFSET_BEGINNING (an absolute 0
                 # can be out of retention range and silently jump to the log
@@ -235,12 +242,21 @@ def _read_real(
             finally:
                 consumer.close()
 
-        # persistence contract (OffsetAntichain analogue + Reader::seek)
-        def offset_state(self) -> dict[int, int]:
-            return dict(self._offsets)
+        # persistence contract (OffsetAntichain analogue + Reader::seek).
+        # The sequential row-key counter travels with the offsets: a restart
+        # that replays N logged events but reset _seq would hand the next
+        # live messages the SAME row keys the replayed events already own.
+        def offset_state(self) -> dict:
+            return {"partitions": dict(self._offsets), "seq": self._seq}
 
-        def seek(self, state: dict[int, int]) -> None:
-            self._offsets = {int(k): int(v) for k, v in state.items()}
+        def seek(self, state: dict) -> None:
+            if "partitions" in state:
+                self._offsets = {
+                    int(k): int(v) for k, v in state["partitions"].items()
+                }
+                self._seq = int(state.get("seq", 0))
+            else:  # legacy bare partition map
+                self._offsets = {int(k): int(v) for k, v in state.items()}
 
         def on_stop(self) -> None:
             self._stop = True
@@ -313,12 +329,19 @@ def read(
                     _time.sleep(poll_interval)
 
         # ---- persistence contract (the per-source OffsetAntichain analogue,
-        # src/persistence/frontier.rs:12 + Reader::seek) ----
-        def offset_state(self) -> dict[int, int]:
-            return dict(self._offsets)
+        # src/persistence/frontier.rs:12 + Reader::seek). _seq travels with
+        # the offsets so restarted live messages never reuse replayed keys ----
+        def offset_state(self) -> dict:
+            return {"partitions": dict(self._offsets), "seq": self._seq}
 
-        def seek(self, state: dict[int, int]) -> None:
-            self._offsets = {int(k): int(v) for k, v in state.items()}
+        def seek(self, state: dict) -> None:
+            if "partitions" in state:
+                self._offsets = {
+                    int(k): int(v) for k, v in state["partitions"].items()
+                }
+                self._seq = int(state.get("seq", 0))
+            else:  # legacy bare partition map
+                self._offsets = {int(k): int(v) for k, v in state.items()}
 
         def on_stop(self) -> None:
             self._stop = True

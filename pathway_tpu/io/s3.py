@@ -13,15 +13,12 @@ cannot be."""
 
 from __future__ import annotations
 
-import csv as _csv
-import io as _io
 import json as _json
 import time as _time
 from typing import Any
 
 from pathway_tpu.internals import schema as schema_mod
 from pathway_tpu.internals.table import Table, table_from_static_data
-from pathway_tpu.io._format import coerce_scalar
 
 
 class AwsS3Settings:
@@ -105,27 +102,10 @@ def _list_objects(client, bucket: str, prefix: str) -> list[tuple[str, str]]:
 def _object_rows(
     client, bucket: str, key: str, fmt: str, schema: schema_mod.SchemaMetaclass
 ) -> list[tuple]:
-    body = client.get_object(Bucket=bucket, Key=key)["Body"].read()
-    cols = schema.column_names()
-    dtypes = schema.dtypes()
-    if fmt == "binary":
-        return [(body,)]
-    text = body.decode(errors="replace")
-    if fmt in ("plaintext", "plaintext_by_object"):
-        if fmt == "plaintext_by_object":
-            return [(text,)]
-        return [(line,) for line in text.splitlines()]
-    if fmt == "csv":
-        rows = []
-        for rec in _csv.DictReader(_io.StringIO(text)):
-            rows.append(tuple(coerce_scalar(rec.get(c, ""), dtypes[c]) for c in cols))
-        return rows
-    if fmt in ("json", "jsonlines"):
-        from pathway_tpu.io._format import JsonLinesParser, RawMessage
+    from pathway_tpu.io._format import rows_from_bytes
 
-        parser = JsonLinesParser(schema)
-        return [ev.values for ev in parser.parse(RawMessage(value=text))]
-    raise ValueError(f"unknown format {fmt!r}")
+    body = client.get_object(Bucket=bucket, Key=key)["Body"].read()
+    return rows_from_bytes(body, fmt, schema)
 
 
 def read(
@@ -199,7 +179,13 @@ def read(
                     found = True
                     if changed:  # full-object replacement: out with the old
                         self._retract(key)
-                    values = _object_rows(cli, bucket, key, fmt, schema)
+                    try:
+                        values = _object_rows(cli, bucket, key, fmt, schema)
+                    except Exception:
+                        # deleted between listing and fetch: the next poll's
+                        # listing will treat it as gone and retract
+                        self._seen.pop(key, None)
+                        continue
                     row_keys_ = self._keys_for(values)
                     assert self._node is not None
                     pairs = [(int(k), v) for k, v in zip(row_keys_, values)]
@@ -250,7 +236,12 @@ def write(
                 k
                 for k, _ in _list_objects(cli, bucket, prefix.rstrip("/") + "/block_")
             ]
-            counter["n"] = len(existing)
+            indices = []
+            for k in existing:
+                stem = k.rsplit("block_", 1)[-1].split(".")[0]
+                if stem.isdigit():
+                    indices.append(int(stem))
+            counter["n"] = (max(indices) + 1) if indices else 0
         key = f"{prefix.rstrip('/')}/block_{counter['n']:08d}.jsonl"
         counter["n"] += 1
         cli.put_object(Bucket=bucket, Key=key, Body=("\n".join(lines) + "\n").encode())

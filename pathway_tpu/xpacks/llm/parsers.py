@@ -41,9 +41,44 @@ def _gated(name: str, module: str):
     return _Gated
 
 
+class PypdfParser(UDF):
+    """PDF → text chunks (reference ``parsers.py:955``). Uses ``pypdf`` when
+    importable; otherwise the pure-Python extraction engine
+    (``xpacks/llm/_pdf.py`` — stdlib-only object/FlateDecode/content-stream
+    parsing), so DocumentStore ingests real PDFs on this image too.
+
+    ``apply_text_cleanup`` collapses whitespace runs like the reference."""
+
+    def __init__(self, apply_text_cleanup: bool = True, **kwargs):
+        import re as _re
+
+        def parse(contents: Any) -> list:
+            if isinstance(contents, bytes):
+                data = contents
+            elif isinstance(contents, str):
+                data = contents.encode("latin-1", errors="replace")
+            else:
+                data = bytes(contents)
+            try:
+                import pypdf  # noqa: F401
+                from io import BytesIO
+
+                reader = pypdf.PdfReader(BytesIO(data))
+                text = "\n".join(page.extract_text() or "" for page in reader.pages)
+            except ImportError:
+                from pathway_tpu.xpacks.llm._pdf import extract_pdf_text
+
+                text = extract_pdf_text(data)
+            if apply_text_cleanup:
+                text = _re.sub(r"[ \t]+", " ", text)
+                text = _re.sub(r"\n{3,}", "\n\n", text).strip()
+            return [(text, {})]
+
+        super().__init__(_fn=parse, return_type=list, **kwargs)
+
+
 UnstructuredParser = _gated("UnstructuredParser", "unstructured")
 ParseUnstructured = UnstructuredParser
 DoclingParser = _gated("DoclingParser", "docling")
-PypdfParser = _gated("PypdfParser", "pypdf")
 ImageParser = _gated("ImageParser", "openparse")
 SlideParser = _gated("SlideParser", "openparse")

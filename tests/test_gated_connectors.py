@@ -521,3 +521,45 @@ def test_kafka_consumer_error_surfaces():
     pw.io.subscribe(t, on_change=lambda **k: None)
     with pytest.raises(RuntimeError, match="kafka consumer error"):
         pw.run(monitoring_level="none")
+
+
+def test_kafka_offset_state_preserves_row_key_counter(tmp_path):
+    """Restart recovery: replayed events keep their keys AND new live messages
+    continue the sequential key counter instead of reusing replayed keys."""
+    from pathway_tpu.io.kafka import MockKafkaBroker
+
+    broker = MockKafkaBroker(path=str(tmp_path / "broker"))
+    broker.create_topic("t", partitions=1)
+    for i in range(3):
+        broker.produce("t", json.dumps({"w": f"a{i}"}))
+
+    backend = pw.persistence.Backend.filesystem(str(tmp_path / "pstate"))
+
+    def run_once(expected):
+        G.clear()
+        t = pw.io.kafka.read(
+            broker, "t", schema=pw.schema_from_types(w=str), format="json",
+            mode="streaming", name="ks",
+        )
+        seen = {}
+        def on_change(key, row, time, is_addition):
+            seen[key] = row["w"]
+            if len(seen) >= expected:
+                rt = pw.internals.run.current_runtime()
+                if rt is not None:
+                    rt.request_stop()
+        pw.io.subscribe(t, on_change=on_change)
+        pw.run(
+            monitoring_level="none",
+            persistence_config=pw.persistence.Config(backend=backend),
+        )
+        return seen
+
+    s1 = run_once(3)
+    assert sorted(s1.values()) == ["a0", "a1", "a2"]
+    for i in range(3, 6):
+        broker.produce("t", json.dumps({"w": f"a{i}"}))
+    s2 = run_once(6)
+    # 6 distinct rows -> 6 distinct keys (no key reuse after restart)
+    assert sorted(s2.values()) == [f"a{i}" for i in range(6)]
+    assert len(s2) == 6

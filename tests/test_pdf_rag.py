@@ -1,0 +1,175 @@
+"""VERDICT r3 #9: real PDF ingestion on this image (pure-Python extraction)
+plus the rag-evals harness quality floor."""
+
+from __future__ import annotations
+
+import zlib
+
+import pytest
+
+import pathway_tpu as pw
+from pathway_tpu.internals.parse_graph import G
+from pathway_tpu.xpacks.llm._pdf import extract_pdf_text
+from utils import rows_of
+
+
+def _make_pdf(pages: list[str], compress: bool = False) -> bytes:
+    """A minimal valid single-font PDF; each page shows its lines via Tj/T*."""
+    objs: list[bytes] = []
+
+    def add(body: bytes) -> int:
+        objs.append(body)
+        return len(objs)
+
+    font = add(b"<< /Type /Font /Subtype /Type1 /BaseFont /Helvetica >>")
+    content_ids = []
+    page_ids_placeholder = []
+    for text in pages:
+        lines = text.split("\n")
+        ops = [b"BT /F1 12 Tf 72 720 Td"]
+        for j, line in enumerate(lines):
+            esc = line.replace("\\", r"\\").replace("(", r"\(").replace(")", r"\)")
+            if j:
+                ops.append(b"0 -14 Td")
+            ops.append(b"(" + esc.encode("latin-1") + b") Tj")
+        ops.append(b"ET")
+        stream = b" ".join(ops)
+        if compress:
+            comp = zlib.compress(stream)
+            body = (
+                b"<< /Length %d /Filter /FlateDecode >>\nstream\n" % len(comp)
+                + comp
+                + b"\nendstream"
+            )
+        else:
+            body = (
+                b"<< /Length %d >>\nstream\n" % len(stream) + stream + b"\nendstream"
+            )
+        content_ids.append(add(body))
+    pages_id = len(objs) + len(pages) + 1  # page objs next, then Pages
+    for cid in content_ids:
+        page_ids_placeholder.append(
+            add(
+                b"<< /Type /Page /Parent %d 0 R /MediaBox [0 0 612 792] "
+                b"/Resources << /Font << /F1 %d 0 R >> >> /Contents %d 0 R >>"
+                % (pages_id, font, cid)
+            )
+        )
+    kids = b" ".join(b"%d 0 R" % p for p in page_ids_placeholder)
+    assert add(
+        b"<< /Type /Pages /Kids [%s] /Count %d >>" % (kids, len(pages))
+    ) == pages_id
+    catalog = add(b"<< /Type /Catalog /Pages %d 0 R >>" % pages_id)
+
+    out = bytearray(b"%PDF-1.4\n")
+    offsets = [0]
+    for i, body in enumerate(objs, start=1):
+        offsets.append(len(out))
+        out += b"%d 0 obj\n" % i + body + b"\nendobj\n"
+    xref_at = len(out)
+    out += b"xref\n0 %d\n" % (len(objs) + 1)
+    out += b"0000000000 65535 f \n"
+    for off in offsets[1:]:
+        out += b"%010d 00000 n \n" % off
+    out += (
+        b"trailer\n<< /Size %d /Root %d 0 R >>\nstartxref\n%d\n%%%%EOF\n"
+        % (len(objs) + 1, catalog, xref_at)
+    )
+    return bytes(out)
+
+
+def test_extract_uncompressed_and_compressed():
+    for compress in (False, True):
+        pdf = _make_pdf(
+            ["Hello PDF world.\nSecond line.", "Page two (with parens) here."],
+            compress=compress,
+        )
+        text = extract_pdf_text(pdf)
+        assert "Hello PDF world." in text
+        assert "Second line." in text
+        assert "Page two (with parens) here." in text
+        # Td line breaks preserved
+        assert "Hello PDF world.\nSecond line." in text.replace("\r", "")
+
+
+def test_extract_tj_array_and_hex():
+    content = b"BT /F1 12 Tf 72 720 Td [(Spl) -20 (it wor) 5 (ds)] TJ T* <48492E> Tj ET"
+    pdf = (
+        b"%PDF-1.4\n1 0 obj\n<< /Length "
+        + str(len(content)).encode()
+        + b" >>\nstream\n"
+        + content
+        + b"\nendstream\nendobj\n%%EOF\n"
+    )
+    text = extract_pdf_text(pdf)
+    assert "Split words" in text.replace("\n", "")
+    assert "HI." in text
+
+
+def test_extract_rejects_non_pdf_and_encrypted():
+    with pytest.raises(ValueError, match="not a PDF"):
+        extract_pdf_text(b"hello")
+    enc = _make_pdf(["secret"]).replace(b"trailer\n<<", b"trailer\n<< /Encrypt 9 0 R")
+    with pytest.raises(ValueError, match="encrypted"):
+        extract_pdf_text(enc)
+
+
+def test_pypdf_parser_udf():
+    from pathway_tpu.xpacks.llm.parsers import PypdfParser
+
+    from pathway_tpu.internals import dtype as dt
+
+    G.clear()
+    pdf = _make_pdf(["The  answer   is 42.\n\n\n\nEnd."], compress=True)
+    t = pw.debug.table_from_rows(pw.schema_from_types(data=bytes), [(pdf,)])
+    parsed = t.select(out=PypdfParser()(pw.this.data))
+    text_only = parsed.select(
+        text=pw.apply_with_type(lambda chunks: chunks[0][0], dt.STR, pw.this.out)
+    )
+    ((text,),) = list(rows_of(text_only))
+    assert "The answer is 42." in text  # whitespace cleanup applied
+
+
+def test_document_store_ingests_pdf_end_to_end(tmp_path):
+    """The done-criterion: DocumentStore ingests a real PDF from disk through
+    the binary fs connector, and retrieval finds its content."""
+    from pathway_tpu.stdlib.indexing import TantivyBM25Factory
+    from pathway_tpu.xpacks.llm.document_store import DocumentStore
+    from pathway_tpu.xpacks.llm.parsers import PypdfParser
+
+    pdf_path = tmp_path / "facts.pdf"
+    pdf_path.write_bytes(
+        _make_pdf(
+            ["The secret launch code is ZEBRA-7.", "Unrelated second page."],
+            compress=True,
+        )
+    )
+    G.clear()
+    docs = pw.io.fs.read(str(tmp_path), format="binary", mode="static", with_metadata=True)
+    store = DocumentStore(
+        docs,
+        retriever_factory=TantivyBM25Factory(),
+        parser=PypdfParser(),
+    )
+    queries = pw.debug.table_from_rows(
+        DocumentStore.RetrieveQuerySchema, [("secret launch code", 1, None, None)]
+    )
+    hits = store.retrieve_query(queries)
+    ((res,),) = list(rows_of(hits))
+    docs_list = res.value if hasattr(res, "value") else res
+    assert docs_list and "ZEBRA-7" in docs_list[0]["text"]
+
+
+def test_rag_evals_quality_floor():
+    """The rag-evals harness (reference integration_tests/rag_evals) must hold
+    a perfect score on its fixed QA set — retrieval + adaptive loop + prompt
+    plumbing are all deterministic here."""
+    import sys as _sys
+    from pathlib import Path
+
+    _sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+    from benchmarks.rag_evals import run
+
+    out = run()
+    assert out["value"] == 1.0, out
+    assert out["answered"] == out["n_questions"]
