@@ -41,9 +41,10 @@ class _SharedWatermark:
     progress channels; here the logical node creates ONE of these at graph
     definition time and every worker's node copy folds its local per-tick max
     into it, so row state can shard by key while the watermark stays global.
-    (Thread-plane only: the multi-process cluster runtime routes
-    ``global_watermark`` nodes SOLO until cross-process watermark gossip
-    lands — see ``parallel/cluster.py``.)"""
+    Across PROCESSES the cluster runtime merges each node's per-process tick
+    maxima through a barrier before every frontier round
+    (``ClusterRuntime._sync_watermarks`` — the watermark-gossip analogue of
+    timely's progress broadcast)."""
 
     __slots__ = ("lock", "watermark", "tick_max")
 

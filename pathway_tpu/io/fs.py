@@ -10,7 +10,6 @@ from __future__ import annotations
 
 import csv as _csv
 import glob as _glob
-import io as _io
 import json as _json
 import os
 import threading
@@ -22,7 +21,6 @@ import numpy as np
 from pathway_tpu.engine import operators as ops
 from pathway_tpu.engine.blocks import DeltaBatch
 from pathway_tpu.engine.graph import Node
-from pathway_tpu.internals import dtype as dt
 from pathway_tpu.internals import schema as schema_mod
 from pathway_tpu.internals.keys import row_keys, sequential_keys, splitmix64
 from pathway_tpu.internals.logical import LogicalNode
@@ -37,9 +35,6 @@ def _list_files(path: str) -> list[str]:
             out.extend(os.path.join(root, f) for f in sorted(files))
         return sorted(out)
     return sorted(_glob.glob(path))
-
-
-from pathway_tpu.io._format import coerce_scalar as _coerce  # shared Parser-layer coercion
 
 
 def _parse_file(

@@ -175,19 +175,22 @@ def _read_real(
         def run(self) -> None:
             consumer = ck.Consumer(_conf_of(settings))
             try:
-                md = consumer.list_topics(topic)
-                tmeta = md.topics.get(topic) if hasattr(md.topics, "get") else None
-                terr = getattr(tmeta, "error", None)
-                if tmeta is None or terr or not getattr(tmeta, "partitions", None):
-                    raise RuntimeError(
-                        f"kafka topic {topic!r} unavailable: "
-                        f"{terr or 'unknown topic / no partitions'}"
+                if partitions is not None:
+                    # explicit assignment: tolerate transient metadata (topic
+                    # mid-creation); consumption starts once leaders resolve
+                    parts = partitions
+                else:
+                    md = consumer.list_topics(topic)
+                    tmeta = (
+                        md.topics.get(topic) if hasattr(md.topics, "get") else None
                     )
-                parts = (
-                    partitions
-                    if partitions is not None
-                    else sorted(tmeta.partitions.keys())
-                )
+                    terr = getattr(tmeta, "error", None)
+                    if tmeta is None or terr or not getattr(tmeta, "partitions", None):
+                        raise RuntimeError(
+                            f"kafka topic {topic!r} unavailable: "
+                            f"{terr or 'unknown topic / no partitions'}"
+                        )
+                    parts = sorted(tmeta.partitions.keys())
                 # fresh partitions start at OFFSET_BEGINNING (an absolute 0
                 # can be out of retention range and silently jump to the log
                 # end via auto.offset.reset)

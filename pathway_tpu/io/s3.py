@@ -99,6 +99,15 @@ def _list_objects(client, bucket: str, prefix: str) -> list[tuple[str, str]]:
     return sorted(out)
 
 
+def _fetch_object(client, bucket: str, key: str) -> bytes | None:
+    """Object bytes, or None when the object vanished between listing and
+    fetch (the one failure that is an expected race, not an error)."""
+    try:
+        return client.get_object(Bucket=bucket, Key=key)["Body"].read()
+    except Exception:
+        return None
+
+
 def _object_rows(
     client, bucket: str, key: str, fmt: str, schema: schema_mod.SchemaMetaclass
 ) -> list[tuple]:
@@ -179,13 +188,17 @@ def read(
                     found = True
                     if changed:  # full-object replacement: out with the old
                         self._retract(key)
-                    try:
-                        values = _object_rows(cli, bucket, key, fmt, schema)
-                    except Exception:
+                    body = _fetch_object(cli, bucket, key)
+                    if body is None:
                         # deleted between listing and fetch: the next poll's
                         # listing will treat it as gone and retract
                         self._seen.pop(key, None)
                         continue
+                    # parse errors are real errors: they surface through the
+                    # connector error channel, not silent re-poll loops
+                    from pathway_tpu.io._format import rows_from_bytes
+
+                    values = rows_from_bytes(body, fmt, schema)
                     row_keys_ = self._keys_for(values)
                     assert self._node is not None
                     pairs = [(int(k), v) for k, v in zip(row_keys_, values)]

@@ -381,11 +381,6 @@ class ClusterRuntime:
             for ci, port in consumers:
                 consumer = lw.graph.nodes[ci]
                 key_fn = consumer.exchange_key(port)
-                if getattr(consumer, "global_watermark", False):
-                    # watermark nodes share a frontier cell across THREADS but
-                    # there is no cross-process watermark gossip yet: keep them
-                    # serial on the global worker 0 in cluster runs
-                    key_fn = SOLO
                 if key_fn is None:
                     consumer.accept(port, batch)
                 elif key_fn == SOLO:
@@ -472,6 +467,46 @@ class ClusterRuntime:
             if not decision["again"]:
                 return
 
+    def _sync_watermarks(self) -> None:
+        """Cross-process watermark gossip (the reference's frontier broadcast
+        over timely's progress channels): merge every global-watermark node's
+        per-process tick maximum so sharded buffer/forget/freeze shards all
+        see the GLOBAL clock before releasing/dropping rows. Runs before each
+        frontier round — frontier-phase emissions can advance the clock
+        mid-tick, and the serial engine would observe those too."""
+        local: dict[int, Any] = {}
+        wm_nodes = []
+        for lw in self.local_workers.values():
+            for node in lw.graph.nodes:
+                if getattr(node, "global_watermark", False):
+                    wm_nodes.append(node)
+                    tm = node._shared.tick_max
+                    if tm is not None:
+                        prev = local.get(node.node_index)
+                        if prev is None or tm > prev:
+                            local[node.node_index] = tm
+        # graphs are aligned across processes, so this skip is symmetric —
+        # every process sees the same wm_nodes emptiness and barrier count
+        if not wm_nodes:
+            return
+
+        def decide(reports):
+            merged: dict[int, Any] = {}
+            for _tag, wm in reports:
+                for idx, tm in wm.items():
+                    if idx not in merged or tm > merged[idx]:
+                        merged[idx] = tm
+            return {"wm": merged}
+
+        decision = self._barrier(("wmsync", local), decide)
+        merged = decision["wm"]
+        for node in wm_nodes:
+            tm = merged.get(node.node_index)
+            if tm is not None:
+                with node._shared.lock:
+                    if node._shared.tick_max is None or tm > node._shared.tick_max:
+                        node._shared.tick_max = tm
+
     def run_tick(self, time: int) -> None:
         self.current_time = time
         # sources poll on global worker 0 only
@@ -481,6 +516,7 @@ class ClusterRuntime:
                 self._route(lw0, node, run_annotated(node, node.poll, time))
         self._round_until_quiescent(time, "sweep")
         while True:
+            self._sync_watermarks()
             progressed = False
             for lw in self.local_workers.values():
                 for node in lw.graph.nodes:

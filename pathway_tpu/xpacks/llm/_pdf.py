@@ -84,9 +84,9 @@ def _parse_literal(data: bytes, i: int) -> tuple[bytes, int]:
             if nxt in _ESCAPES:
                 out += _ESCAPES[nxt]
                 i += 2
-            elif nxt.isdigit():  # octal \ddd (1-3 digits)
+            elif nxt in b"01234567" and nxt != b"":  # octal \ddd (1-3 digits)
                 j = i + 1
-                while j < min(i + 4, n) and data[j : j + 1].isdigit():
+                while j < min(i + 4, n) and data[j : j + 1] in b"01234567" and data[j:j+1] != b"":
                     j += 1
                 out.append(int(data[i + 1 : j], 8) & 0xFF)
                 i = j
