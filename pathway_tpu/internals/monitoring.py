@@ -146,6 +146,92 @@ class MonitoringHttpServer:
         self.server.server_close()
 
 
+class LiveDashboard:
+    """Live console dashboard during a streaming run (reference:
+    ``internals/monitoring.py:22-271`` — the rich-based table of per-connector
+    message counts and per-operator latency, refreshed while the run lives).
+
+    Renders the same per-operator stats table as :func:`print_summary` plus
+    latency/lag probes, redrawing in place with ANSI cursor control every
+    ``refresh_s``. Starts only when the output stream is a TTY (or
+    ``force=True`` for tests) — exactly when a human is watching."""
+
+    def __init__(self, runtime, level: str, file=None, refresh_s: float = 1.0, force: bool = False):
+        self.runtime = runtime
+        self.level = level
+        self.file = file or sys.stderr
+        self.refresh_s = refresh_s
+        self.force = force
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        self._last_lines = 0
+
+    def should_run(self) -> bool:
+        if self.level in (None, "none"):
+            return False
+        return self.force or getattr(self.file, "isatty", lambda: False)()
+
+    def _render(self) -> str:
+        stats = run_stats(self.runtime)
+        ops = stats["operators"]
+        if self.level in ("in_out", "auto"):
+            edge = {"stream_input", "static_input", "subscribe", "capture", "output"}
+            shown = [o for o in ops if o["operator"] in edge or o["operator"].split(":")[0].endswith("_write")]
+            if not shown:
+                shown = ops
+        else:
+            shown = ops
+        width = max([len(o["operator"]) for o in shown] + [8])
+        head = (
+            f"{'operator':<{width}}  {'rows_in':>10}  {'rows_out':>10}  "
+            f"{'latency_ms':>10}  {'lag':>5}"
+        )
+        lines = [
+            f"tick {stats['current_time']}  rows_in {stats['rows_in_total']}  "
+            f"rows_out {stats['rows_out_total']}",
+            head,
+        ]
+        for o in shown:
+            lag = "-" if o.get("lag") is None else str(o["lag"])
+            lines.append(
+                f"{o['operator']:<{width}}  {o['rows_in']:>10}  {o['rows_out']:>10}  "
+                f"{o['latency_ms']:>10.2f}  {lag:>5}"
+            )
+        return "\n".join(lines)
+
+    def _draw(self) -> None:
+        text = self._render()
+        lines = text.count("\n") + 1
+        out = ""
+        if self._last_lines:
+            out += f"\x1b[{self._last_lines}F\x1b[J"  # up N lines, clear below
+        out += text + "\n"
+        self.file.write(out)
+        getattr(self.file, "flush", lambda: None)()
+        self._last_lines = lines
+
+    def start(self) -> "LiveDashboard":
+        if not self.should_run():
+            return self
+
+        def loop() -> None:
+            while not self._stop.wait(self.refresh_s):
+                try:
+                    self._draw()
+                except Exception:
+                    return  # never let the dashboard kill a run
+            self._draw()  # final state
+
+        self._thread = threading.Thread(target=loop, daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)
+
+
 def print_summary(runtime, level: str, file=None) -> str | None:
     """Console dashboard at run end (reference's monitoring table, condensed).
 

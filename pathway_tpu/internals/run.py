@@ -171,6 +171,10 @@ def run(
     from pathway_tpu.internals import telemetry as _telemetry
 
     t_start_ns = _time.time_ns()
+    level = monitoring_level if isinstance(monitoring_level, str) else "auto"
+    from pathway_tpu.internals.monitoring import LiveDashboard, print_summary
+
+    dashboard = LiveDashboard(runtime, level).start()
     ok = False
     try:
         runtime.run(list(G.outputs))
@@ -183,11 +187,10 @@ def run(
         _errors.set_error_policy(prev_policy)
         if http_server is not None:
             http_server.stop()
+        dashboard.stop()
         _telemetry.maybe_export_run_trace(runtime, t_start_ns)
-        from pathway_tpu.internals.monitoring import print_summary
-
-        level = monitoring_level if isinstance(monitoring_level, str) else "auto"
-        print_summary(runtime, level)
+        if dashboard._thread is None:  # dashboard didn't run (no TTY): summary
+            print_summary(runtime, level)
     return None
 
 

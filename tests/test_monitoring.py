@@ -204,3 +204,36 @@ def test_set_monitoring_config_trace_file(tmp_path):
     with open(path) as fh:
         doc = json.load(fh)
     assert doc["resourceSpans"]
+
+
+def test_live_dashboard_renders_during_streaming():
+    """The live dashboard (reference's rich monitoring table) refreshes
+    per-operator stats with latency/lag while the run is live."""
+    from pathway_tpu.internals.monitoring import LiveDashboard
+
+    rows: list = []
+    _streaming_pipeline(rows)
+    buf = io.StringIO()
+
+    captured = {}
+
+    def run_with_dashboard():
+        import pathway_tpu.internals.run as run_mod
+
+        rt = run_mod.make_runtime(monitoring_level="all", autocommit_duration_ms=5)
+        run_mod._last_runtime = rt
+        dash = LiveDashboard(rt, "all", file=buf, refresh_s=0.05, force=True).start()
+        try:
+            rt.run(list(pw.internals.parse_graph.G.outputs))
+        finally:
+            dash.stop()
+        captured["dash"] = dash
+
+    run_with_dashboard()
+    text = buf.getvalue()
+    assert "operator" in text and "latency_ms" in text and "lag" in text
+    assert "groupby" in text
+    assert "\x1b[" in text  # in-place redraw happened at least once
+    # final frame shows the complete row counts
+    last = text.rsplit("\x1b[", 1)[-1]
+    assert "stream_input" in last or "groupby" in last
