@@ -63,6 +63,24 @@ def scheduler_stats(scheduler) -> list[dict[str, Any]]:
     return ops
 
 
+#: operators shown at the in_out/auto levels: sources, sinks, and writers
+_EDGE_OPERATORS = {"stream_input", "static_input", "subscribe", "capture", "output"}
+
+
+def _visible_operators(ops: list[dict], level: str) -> list[dict]:
+    """The operator rows a given monitoring level displays — shared by the
+    live dashboard and the end-of-run summary so the two can never drift."""
+    if level in ("in_out", "auto"):
+        shown = [
+            o
+            for o in ops
+            if o["operator"] in _EDGE_OPERATORS
+            or o["operator"].split(":")[0].endswith("_write")
+        ]
+        return shown or ops
+    return ops
+
+
 def run_stats(runtime) -> dict[str, Any]:
     scheduler = getattr(runtime, "scheduler", None)
     ops = scheduler_stats(scheduler)
@@ -165,6 +183,7 @@ class LiveDashboard:
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
         self._last_lines = 0
+        self.failed = False
 
     def should_run(self) -> bool:
         if self.level in (None, "none"):
@@ -173,14 +192,7 @@ class LiveDashboard:
 
     def _render(self) -> str:
         stats = run_stats(self.runtime)
-        ops = stats["operators"]
-        if self.level in ("in_out", "auto"):
-            edge = {"stream_input", "static_input", "subscribe", "capture", "output"}
-            shown = [o for o in ops if o["operator"] in edge or o["operator"].split(":")[0].endswith("_write")]
-            if not shown:
-                shown = ops
-        else:
-            shown = ops
+        shown = _visible_operators(stats["operators"], self.level)
         width = max([len(o["operator"]) for o in shown] + [8])
         head = (
             f"{'operator':<{width}}  {'rows_in':>10}  {'rows_out':>10}  "
@@ -215,12 +227,14 @@ class LiveDashboard:
             return self
 
         def loop() -> None:
-            while not self._stop.wait(self.refresh_s):
-                try:
+            try:
+                while not self._stop.wait(self.refresh_s):
                     self._draw()
-                except Exception:
-                    return  # never let the dashboard kill a run
-            self._draw()  # final state
+                self._draw()  # final state
+            except Exception:
+                # never let the dashboard kill a run; the run-end summary
+                # still prints because `failed` records the dead display
+                self.failed = True
 
         self._thread = threading.Thread(target=loop, daemon=True)
         self._thread.start()
@@ -244,10 +258,9 @@ def print_summary(runtime, level: str, file=None) -> str | None:
     if level == "auto" and not getattr(file, "isatty", lambda: False)():
         return None
     stats = run_stats(runtime)
-    ops = stats["operators"]
-    if level == "in_out":
-        edge = {"stream_input", "static_input", "subscribe", "capture", "output"}
-        ops = [o for o in ops if o["operator"] in edge]
+    # summary semantics: auto shows everything (one final table); the LIVE
+    # dashboard narrows auto to the edge operators instead
+    ops = _visible_operators(stats["operators"], "all" if level == "auto" else level)
     width = max([len(o["operator"]) for o in ops] + [8])
     lines = [f"{'operator':<{width}}  {'rows_in':>10}  {'rows_out':>10}  {'time_ms':>10}"]
     for o in ops:

@@ -189,7 +189,8 @@ def run(
             http_server.stop()
         dashboard.stop()
         _telemetry.maybe_export_run_trace(runtime, t_start_ns)
-        if dashboard._thread is None:  # dashboard didn't run (no TTY): summary
+        if dashboard._thread is None or dashboard.failed:
+            # no dashboard ran (no TTY) or its display died: print the summary
             print_summary(runtime, level)
     return None
 

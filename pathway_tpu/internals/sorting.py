@@ -5,10 +5,14 @@ bidirectional differential cursors, SURVEY §2.9): for every row, emit pointers 
 previous/next row in ``key`` order within its ``instance`` partition. Output universe
 equals the input universe; columns are ``prev``/``next`` Optional[Pointer].
 
-Incrementality: the node keeps each instance's order as a sorted list and the
-previously-emitted (prev, next) per key; a delta re-derives only the mutated rows'
-neighborhoods (cursor-local, like the reference's bidirectional cursors) — a 1-row
-change does O(log n) python work plus the list memmove, not an instance rescan.
+Incrementality: each instance's order lives in a blocked sorted list
+(``_BlockedSortedList`` — list-of-blocks, the sortedcontainers design), so a
+1-row change costs O(log n) search + an O(sqrt n) block memmove instead of the
+flat list's O(n) memmove; neighbor queries are block-local with edge
+spillover, the role of the reference's O(1) bidirectional cursors. Only the
+mutated rows' neighborhoods re-derive. Instances are independent, so the node
+shards by instance hash across workers (SOLO only for the global
+single-instance sort).
 """
 
 from __future__ import annotations
@@ -24,15 +28,138 @@ from pathway_tpu.internals import dtype as dt
 from pathway_tpu.internals.logical import LogicalNode
 
 
+class _BlockedSortedList:
+    """Sorted multiset of comparable items in ~sqrt(n) blocks.
+
+    insert/remove: O(log n) block search + O(block) memmove. neighbors:
+    block-local lookups spilling into adjacent blocks at the edges."""
+
+    LOAD = 512
+
+    __slots__ = ("_blocks", "_maxes", "_len")
+
+    def __init__(self) -> None:
+        self._blocks: list[list] = []
+        self._maxes: list = []
+        self._len = 0
+
+    def __len__(self) -> int:
+        return self._len
+
+    def _block_of(self, item) -> int:
+        b = bisect.bisect_left(self._maxes, item)
+        return min(b, len(self._blocks) - 1)
+
+    def insert(self, item) -> None:
+        if not self._blocks:
+            self._blocks.append([item])
+            self._maxes.append(item)
+            self._len = 1
+            return
+        b = self._block_of(item)
+        block = self._blocks[b]
+        bisect.insort(block, item)
+        self._maxes[b] = block[-1]
+        self._len += 1
+        if len(block) > 2 * self.LOAD:
+            half = len(block) // 2
+            right = block[half:]
+            del block[half:]
+            self._blocks.insert(b + 1, right)
+            self._maxes[b] = block[-1]
+            self._maxes.insert(b + 1, right[-1])
+
+    def remove(self, item) -> bool:
+        if not self._blocks:
+            return False
+        b = self._block_of(item)
+        block = self._blocks[b]
+        pos = bisect.bisect_left(block, item)
+        if pos >= len(block) or block[pos] != item:
+            return False
+        block.pop(pos)
+        self._len -= 1
+        if not block:
+            del self._blocks[b]
+            del self._maxes[b]
+        elif len(block) < self.LOAD // 2 and len(self._blocks) > 1:
+            # merge undersized blocks (sortedcontainers discipline) so churn
+            # cannot degrade toward one-element blocks / O(n) block lists
+            nb = b + 1 if b + 1 < len(self._blocks) else b - 1
+            lo, hi = min(b, nb), max(b, nb)
+            merged = self._blocks[lo] + self._blocks[hi]
+            self._blocks[lo] = merged
+            self._maxes[lo] = merged[-1]
+            del self._blocks[hi]
+            del self._maxes[hi]
+            if len(merged) > 2 * self.LOAD:
+                half = len(merged) // 2
+                right = merged[half:]
+                del merged[half:]
+                self._blocks.insert(lo + 1, right)
+                self._maxes[lo] = merged[-1]
+                self._maxes.insert(lo + 1, right[-1])
+        else:
+            self._maxes[b] = block[-1]
+        return True
+
+    def neighbors(self, item) -> tuple[Any, Any]:
+        """(previous item, next item) around ``item`` (which must be present),
+        None at the ends."""
+        b = self._block_of(item)
+        block = self._blocks[b]
+        pos = bisect.bisect_left(block, item)
+        prev_item = None
+        next_item = None
+        if pos > 0:
+            prev_item = block[pos - 1]
+        elif b > 0:
+            prev_item = self._blocks[b - 1][-1]
+        if pos + 1 < len(block):
+            next_item = block[pos + 1]
+        elif b + 1 < len(self._blocks):
+            next_item = self._blocks[b + 1][0]
+        return prev_item, next_item
+
+    def __contains__(self, item) -> bool:
+        if not self._blocks:
+            return False
+        b = self._block_of(item)
+        block = self._blocks[b]
+        pos = bisect.bisect_left(block, item)
+        return pos < len(block) and block[pos] == item
+
+
 class SortNode(Node):
     name = "sort"
 
     snapshot_attrs = ("_row_info", "_orders", "_emitted")
 
     def exchange_key(self, port):
-        from pathway_tpu.engine.graph import SOLO
+        if self.instance_fn is None:
+            from pathway_tpu.engine.graph import SOLO
 
-        return SOLO  # global-watermark / ordered state: serial on worker 0
+            return SOLO  # one global order: serial
+        # Per-instance orders are independent: shard by instance hash. Engine
+        # contract note: updates arrive as retract+insert pairs, and each leg
+        # carries its own row values — the retraction hashes the OLD instance
+        # and reaches the shard holding the old entry. A bare re-insert that
+        # CHANGES the instance (out of contract) would leave stale state on
+        # the old shard; the in-node upsert defense below still covers bare
+        # re-inserts that keep their instance (same shard).
+        from pathway_tpu.internals.keys import hash_column
+
+        fn = self.instance_fn
+
+        def key_fn(batch):
+            vals = np.asarray(fn(batch))
+            if vals.dtype.kind not in "OUS":
+                return hash_column(vals)
+            out = np.empty(len(vals), dtype=object)
+            out[:] = list(vals)
+            return hash_column(out)
+
+        return key_fn
 
     def __init__(
         self,
@@ -42,9 +169,10 @@ class SortNode(Node):
         super().__init__(n_inputs=1)
         self.key_fn = key_fn
         self.instance_fn = instance_fn
-        # row key -> (instance, sort_key); instance -> sorted [(sort_key, row_key)]
+        # row key -> (instance, sort_key); instance -> blocked sorted list of
+        # (sort_key, row_key)
         self._row_info: dict[int, tuple[Any, Any]] = {}
-        self._orders: dict[Any, list[tuple[Any, int]]] = {}
+        self._orders: dict[Any, _BlockedSortedList] = {}
         # row key -> (prev, next) currently emitted
         self._emitted: dict[int, tuple[int | None, int | None]] = {}
 
@@ -60,48 +188,46 @@ class SortNode(Node):
         )
         # only the NEIGHBORHOODS of mutated rows can change their (prev, next)
         # pair — collect affected keys instead of rescanning whole instances
-        # (the rescan made a 1-row delta cost O(instance) in python; VERDICT r2
-        # carried this from r1)
         affected: dict = {}
+
+        def note_neighbors(inst, item) -> None:
+            order = self._orders.get(inst)
+            if order is None or item not in order:
+                return
+            prev_item, next_item = order.neighbors(item)
+            aff = affected.setdefault(inst, set())
+            if prev_item is not None:
+                aff.add(prev_item[1])
+            if next_item is not None:
+                aff.add(next_item[1])
+
         for i in range(len(batch)):
             key = int(batch.keys[i])
             if batch.diffs[i] > 0:
                 old_info = self._row_info.get(key)
                 if old_info is not None:
                     # upsert: a re-inserted key must not duplicate its entry
-                    oorder = self._orders.get(old_info[0], [])
-                    opos = bisect.bisect_left(oorder, (old_info[1], key))
-                    if opos < len(oorder) and oorder[opos] == (old_info[1], key):
-                        oorder.pop(opos)
-                        oaff = affected.setdefault(old_info[0], set())
-                        if opos > 0:
-                            oaff.add(oorder[opos - 1][1])
-                        if opos < len(oorder):
-                            oaff.add(oorder[opos][1])
+                    note_neighbors(old_info[0], (old_info[1], key))
+                    oorder = self._orders.get(old_info[0])
+                    if oorder is not None:
+                        oorder.remove((old_info[1], key))
                 info = (instances[i], sort_keys[i])
                 self._row_info[key] = info
-                order = self._orders.setdefault(info[0], [])
-                pos = bisect.bisect_left(order, (info[1], key))
-                order.insert(pos, (info[1], key))
+                order = self._orders.get(info[0])
+                if order is None:
+                    order = self._orders[info[0]] = _BlockedSortedList()
+                order.insert((info[1], key))
                 aff = affected.setdefault(info[0], set())
                 aff.add(key)
-                if pos > 0:
-                    aff.add(order[pos - 1][1])
-                if pos + 1 < len(order):
-                    aff.add(order[pos + 1][1])
+                note_neighbors(info[0], (info[1], key))
             else:
                 info = self._row_info.pop(key, None)
                 if info is None:
                     continue
-                order = self._orders.get(info[0], [])
-                pos = bisect.bisect_left(order, (info[1], key))
-                if pos < len(order) and order[pos] == (info[1], key):
-                    order.pop(pos)
-                aff = affected.setdefault(info[0], set())
-                if pos > 0:
-                    aff.add(order[pos - 1][1])
-                if pos < len(order):
-                    aff.add(order[pos][1])
+                note_neighbors(info[0], (info[1], key))
+                order = self._orders.get(info[0])
+                if order is not None:
+                    order.remove((info[1], key))
 
         out_keys: list[int] = []
         out_diffs: list[int] = []
@@ -113,14 +239,14 @@ class SortNode(Node):
             out_rows.append(pair)
 
         for inst, keys in affected.items():
-            order = self._orders.get(inst, [])
+            order = self._orders.get(inst)
             for key in sorted(keys):
                 info = self._row_info.get(key)
                 if info is None:
                     continue  # deleted this batch; retraction emitted below
-                pos = bisect.bisect_left(order, (info[1], key))
-                prev_key = order[pos - 1][1] if pos > 0 else None
-                next_key = order[pos + 1][1] if pos + 1 < len(order) else None
+                prev_item, next_item = order.neighbors((info[1], key))
+                prev_key = prev_item[1] if prev_item is not None else None
+                next_key = next_item[1] if next_item is not None else None
                 pair = (prev_key, next_key)
                 old = self._emitted.get(key)
                 if old == pair:

@@ -188,3 +188,51 @@ def test_watermark_is_global_across_shards():
     r1, r4 = both(build)
     assert r1 == r4
     assert len(r1) == 2
+
+
+def test_sort_sharded_by_instance_identical():
+    """SortNode shards by instance hash; prev/next chains per instance are
+    byte-identical to the serial run."""
+
+    def build():
+        t = _stream(seed=11, n=300, n_keys=6)
+        s = t.sort(key=t.t, instance=t.k)
+        return t.select(k=t.k, t=t.t, prev=s.prev, next=s.next)
+
+    r1, r4 = both(build)
+    assert r1 == r4
+    assert len(r1) > 100
+
+
+def test_blocked_sorted_list_contract():
+    import random
+
+    from pathway_tpu.internals.sorting import _BlockedSortedList
+
+    random.seed(0)
+    ref: list = []
+
+    class Small(_BlockedSortedList):  # tiny blocks: force many splits/merges
+        LOAD = 8
+
+    bl = Small()
+    import bisect
+
+    for step in range(4000):
+        if ref and random.random() < 0.4:
+            item = random.choice(ref)
+            ref.remove(item)
+            assert bl.remove(item)
+        else:
+            item = (random.randrange(1000), step)
+            bisect.insort(ref, item)
+            bl.insert(item)
+        if ref and step % 97 == 0:
+            probe = random.choice(ref)
+            i = ref.index(probe)
+            want = (
+                ref[i - 1] if i > 0 else None,
+                ref[i + 1] if i + 1 < len(ref) else None,
+            )
+            assert bl.neighbors(probe) == want
+    assert len(bl) == len(ref)
