@@ -1,0 +1,126 @@
+"""On-device key-shard exchange for relational blocks (SURVEY §5.8 end state).
+
+The reference exchanges records between workers over timely's channels
+(shared memory / TCP); the host plane here does the same with pickled blocks
+(``parallel/cluster.py``). This module is the ICI/DCN data plane the north
+star calls for: NUMERIC column blocks are re-sharded **on device** with one
+``lax.all_to_all`` per tick — rows ride the interconnect as dense tensors,
+with the shard function identical to the host plane
+(``mesh.shard_of_keys``: low key bits mod worker count, ``shard.rs`` parity).
+
+Shape discipline (XLA needs static shapes): every device holds a fixed
+``capacity``-row block with a validity mask; the kernel buckets rows by
+destination into an ``(n_shards, capacity)`` staging tensor and all-to-alls
+it; the output stays padded at ``n_shards*capacity`` rows per device with a
+validity mask (no dynamic-shape compaction on device — consumers apply the
+mask). Per-destination capacity is the full block capacity, so no row can
+overflow regardless of skew; the cost is an ``n_shards×`` staging buffer,
+the standard static-shape trade.
+
+Scope: the host TCP plane remains the default for the general engine (blocks
+carry strings/objects); this path serves the numeric fast lane — groupby /
+join key-partitioning of numeric columns — and is exercised multi-chip by
+``__graft_entry__.dryrun_multichip`` plus an 8-device CPU-mesh test
+(``tests/test_device_exchange.py``) that checks bit-parity with the host
+exchange + groupby.
+"""
+
+from __future__ import annotations
+
+from functools import lru_cache, partial
+
+import numpy as np
+
+from pathway_tpu.internals.keys import SHARD_MASK
+
+
+@lru_cache(maxsize=64)
+def _jitted_exchange(mesh, axis: str, n_cols: int):
+    """One compiled exchange per (mesh, axis, column-count): jit caches on
+    function identity, so the per-tick call must reuse one closure or every
+    tick would pay a full retrace+compile."""
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    n = mesh.shape[axis]
+    kern = _kernel(n, axis)
+    return jax.jit(
+        jax.shard_map(
+            kern,
+            mesh=mesh,
+            in_specs=(P(None, axis), P(axis), P(axis), [P(axis)] * n_cols),
+            out_specs=(P(None, axis), P(axis), P(axis), [P(axis)] * n_cols),
+        )
+    )
+
+
+def _kernel(n_shards: int, axis: str):
+    import jax
+    import jax.numpy as jnp
+
+    def local(keys, diffs, valid, cols):
+        # keys arrive as uint32 pairs (hi, lo) — x64 stays off
+        cap = keys.shape[1]
+        hi, lo = keys[0], keys[1]
+        shard = ((lo & jnp.uint32(SHARD_MASK & 0xFFFFFFFF)) % jnp.uint32(n_shards)).astype(
+            jnp.int32
+        )
+        shard = jnp.where(valid, shard, n_shards)  # invalid rows go nowhere
+        # position of each row within its destination bucket
+        onehot = (shard[None, :] == jnp.arange(n_shards)[:, None]).astype(jnp.int32)
+        pos_in_dest = jnp.cumsum(onehot, axis=1) - 1  # (n, cap)
+        pos = jnp.take_along_axis(
+            pos_in_dest, jnp.clip(shard, 0, n_shards - 1)[None, :], axis=0
+        )[0]
+
+        def stage(arr, fill):
+            buf = jnp.full((n_shards, cap) + arr.shape[1:], fill, dtype=arr.dtype)
+            # invalid rows carry dest == n_shards: out of bounds, dropped —
+            # a dummy in-bounds write would clobber a real row's slot
+            return buf.at[shard, pos].set(arr, mode="drop")
+
+        s_hi = stage(hi, jnp.uint32(0))
+        s_lo = stage(lo, jnp.uint32(0))
+        s_diff = stage(diffs, jnp.int32(0))
+        s_valid = stage(valid, False)
+        s_cols = [stage(c, jnp.zeros((), c.dtype)) for c in cols]
+
+        a2a = partial(jax.lax.all_to_all, axis_name=axis, split_axis=0, concat_axis=0)
+        r_hi, r_lo = a2a(s_hi), a2a(s_lo)
+        r_diff, r_valid = a2a(s_diff), a2a(s_valid)
+        r_cols = [a2a(c) for c in s_cols]
+        # received: (n_shards, cap) blocks → flat (n_shards*cap) rows + mask
+        flat = lambda x: x.reshape((n_shards * cap,) + x.shape[2:])  # noqa: E731
+        return (
+            jnp.stack([flat(r_hi), flat(r_lo)]),
+            flat(r_diff),
+            flat(r_valid),
+            [flat(c) for c in r_cols],
+        )
+
+    return local
+
+
+def exchange_by_key(mesh, axis: str, keys, diffs, cols, valid):
+    """Re-shard padded per-device blocks so every row lands on the device
+    owning its key shard (host-plane parity: ``mesh.shard_of_keys``).
+
+    Inputs are GLOBAL arrays sharded along ``axis`` on their first dim:
+    ``keys`` uint32 (2, n_dev*cap) as (hi, lo) pairs, ``diffs`` int32,
+    ``valid`` bool, ``cols`` list of numeric arrays. Returns the same
+    structure with per-device row counts expanded to ``n_shards*cap`` (masked).
+    """
+    fn = _jitted_exchange(mesh, axis, len(cols))
+    return fn(keys, diffs, valid, cols)
+
+
+def split_keys_u64(keys: np.ndarray) -> np.ndarray:
+    """uint64 host keys → (2, n) uint32 (hi, lo) device representation."""
+    k = keys.astype(np.uint64)
+    return np.stack(
+        [(k >> np.uint64(32)).astype(np.uint32), (k & np.uint64(0xFFFFFFFF)).astype(np.uint32)]
+    )
+
+
+def join_keys_u64(pairs: np.ndarray) -> np.ndarray:
+    return (pairs[0].astype(np.uint64) << np.uint64(32)) | pairs[1].astype(np.uint64)
