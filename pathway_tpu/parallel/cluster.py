@@ -354,6 +354,8 @@ class ClusterRuntime:
             if w == 0:
                 ctx.finish()
                 self._ctx0 = ctx
+            self._ctx_local = ctx  # any local context (non-0 processes have no
+            # global worker 0; persistence reads only the graph shape from it)
             self.local_workers[w] = _LocalWorker(w, ctx.graph)
 
     # ---------------------------------------------------------------- routing
@@ -543,8 +545,14 @@ class ClusterRuntime:
             self.coord.wait_connections()
         else:
             self.client = _CoordinatorClient(self.first_port)
-        if self.persistence is not None and self.pid == 0:
-            self.persistence.on_graph_built(self._ctx0)
+        if self.persistence is not None and (
+            self.pid == 0 or getattr(self.persistence, "operator_mode", False)
+        ):
+            # input snapshots live with the sources on process 0; operator
+            # mode additionally snapshots/restores every process's own worker
+            # shards (barrier-coordinated, see snapshots.py), so its hooks run
+            # on ALL processes
+            self.persistence.on_graph_built(getattr(self, "_ctx0", self._ctx_local))
             self.on_tick_done.append(self.persistence.on_tick_done)
         if self.pid == 0:
             for driver in self.connectors:
@@ -596,7 +604,9 @@ class ClusterRuntime:
         for lw in self.local_workers.values():
             for node in lw.graph.nodes:
                 node.on_end()
-        if self.persistence is not None and self.pid == 0:
+        if self.persistence is not None and (
+            self.pid == 0 or getattr(self.persistence, "operator_mode", False)
+        ):
             self.persistence.on_close()
         if self.client is not None:
             self.client.close()

@@ -244,7 +244,7 @@ class _OperatorSnapshots:
     def stored_workers(self) -> int:
         return self.manifest.get("n_workers", 1) if self.manifest else 1
 
-    def restore(self, worker_nodes: list[list]) -> None:
+    def restore(self, worker_nodes: dict[int, list]) -> None:
         """Per-worker state restore (reference: every worker's operators are
         wrapped individually, ``dataflow/persist.rs:843``). State shards are
         positional per (worker, node), so worker count must match — checked
@@ -254,7 +254,7 @@ class _OperatorSnapshots:
         multi-worker runtimes) and restore through the legacy path."""
         g = self.manifest["gen"]
         legacy = "n_workers" not in self.manifest
-        for w, nodes in enumerate(worker_nodes):
+        for w, nodes in worker_nodes.items():
             for node in nodes:
                 key = (
                     f"operators/gen_{g:08d}/node_{node.node_index:05d}"
@@ -265,24 +265,11 @@ class _OperatorSnapshots:
                 if raw is not None:
                     node.restore_state(pickle.loads(raw))
 
-    def save(
-        self,
-        worker_nodes: list[list],
-        node_names: list[str],
-        input_offsets: dict[str, int],
-        tick: int,
-    ) -> None:
-        """Snapshot every worker's node shards at a quiesced tick boundary.
-
-        The global-consistency argument mirrors the reference's finalized-time
-        consensus (``src/persistence/state.rs:291``): this runs from
-        ``on_tick_done``, after ``run_tick`` has drained every worker and the
-        barrier rounds found no pending work anywhere — so all workers' state
-        reflects exactly the same input prefix (the one ``input_offsets``
-        records), and a single manifest commit covers all shards atomically.
-        """
+    def save_shards(self, worker_nodes: dict[int, list]) -> None:
+        """Write this process's worker shards for the CURRENT generation
+        (no commit yet — the manifest is the only commit point)."""
         g = self.gen
-        for w, nodes in enumerate(worker_nodes):
+        for w, nodes in worker_nodes.items():
             for node in nodes:
                 state = node.snapshot_state()
                 if state is None:
@@ -291,7 +278,17 @@ class _OperatorSnapshots:
                     f"operators/gen_{g:08d}/worker_{w:03d}/node_{node.node_index:05d}",
                     pickle.dumps(state),
                 )
-        # the manifest is the commit point: readers only ever follow it
+
+    def commit(
+        self,
+        node_names: list,
+        input_offsets: dict[str, int],
+        tick: int,
+        n_workers: int,
+    ) -> None:
+        """Publish the current generation (single writer — worker/process 0)
+        and garbage-collect the previous one."""
+        g = self.gen
         self.backend.put(
             _MANIFEST,
             pickle.dumps(
@@ -300,15 +297,38 @@ class _OperatorSnapshots:
                     "tick": tick,
                     "input_offsets": input_offsets,
                     "node_names": node_names,
-                    "n_workers": len(worker_nodes),
+                    "n_workers": n_workers,
                 }
             ),
         )
         if g > 0:
             for k in self.backend.list_keys(f"operators/gen_{g - 1:08d}/"):
                 self.backend.delete(k)
+
+    def advance(self) -> None:
         self.gen += 1
         self._last_save = _time.monotonic()
+
+    def save(
+        self,
+        worker_nodes: dict[int, list],
+        node_names: list,
+        input_offsets: dict[str, int],
+        tick: int,
+    ) -> None:
+        """Single-process path: snapshot every worker's node shards at a
+        quiesced tick boundary, then commit.
+
+        The global-consistency argument mirrors the reference's finalized-time
+        consensus (``src/persistence/state.rs:291``): this runs from
+        ``on_tick_done``, after ``run_tick`` has drained every worker and the
+        barrier rounds found no pending work anywhere — so all workers' state
+        reflects exactly the same input prefix (the one ``input_offsets``
+        records), and a single manifest commit covers all shards atomically.
+        """
+        self.save_shards(worker_nodes)
+        self.commit(node_names, input_offsets, tick, len(worker_nodes))
+        self.advance()
 
 
 class Persistence:
@@ -319,20 +339,35 @@ class Persistence:
         self.operator_mode = config.persistence_mode == "operator_persisting"
         self.inputs: list[_PersistedInput] = []
         self.opsnap: _OperatorSnapshots | None = None
-        self._worker_nodes: list[list] = []
+        self._worker_nodes: dict[int, list] = {}
         self._node_names: list = []
+        self._is_cluster = False
+        self._pid = 0
+        self._total_workers = 1
 
     # called by Runtime once the engine graph is built, before drivers start
     def on_graph_built(self, ctx) -> None:
         offsets: dict[str, int] = {}
         if self.operator_mode:
-            # sharded runtimes hold per-worker aligned node shards; the single
-            # runtime is the 1-worker case of the same layout
+            # worker shards keyed by GLOBAL worker index: the single runtime is
+            # {0: nodes}, the thread-sharded runtime {0..W-1}, and a cluster
+            # process contributes only the workers it hosts (every process
+            # snapshots/restores its own shards; process 0 commits)
+            local_workers = getattr(self.runtime, "local_workers", None)
             workers = getattr(self.runtime, "workers", None)
-            if workers:
-                self._worker_nodes = [list(w.graph.nodes) for w in workers]
+            if local_workers is not None:
+                self._is_cluster = True
+                self._pid = self.runtime.pid
+                self._worker_nodes = {
+                    gi: list(lw.graph.nodes) for gi, lw in local_workers.items()
+                }
+                self._total_workers = self.runtime.n_workers
+            elif workers:
+                self._worker_nodes = {w.index: list(w.graph.nodes) for w in workers}
+                self._total_workers = len(workers)
             else:
-                self._worker_nodes = [list(ctx.graph.nodes)]
+                self._worker_nodes = {0: list(ctx.graph.nodes)}
+                self._total_workers = 1
             self._node_names = [
                 (
                     n.name,
@@ -340,20 +375,20 @@ class Persistence:
                     tuple(getattr(n, "columns", None) or getattr(n, "out_columns", []) or []),
                     tuple(ctx.graph.edges.get(n.node_index, [])),
                 )
-                for n in self._worker_nodes[0]
+                for n in next(iter(self._worker_nodes.values()))
             ]
             self.opsnap = _OperatorSnapshots(
                 self.backend, self.config.snapshot_interval_ms / 1000.0
             )
             if self.opsnap.manifest is not None:
-                if self.opsnap.stored_workers() != len(self._worker_nodes):
+                if self.opsnap.stored_workers() != self._total_workers:
                     # state shards are positional per worker; resharding them
                     # on restart is future work — refuse loudly (compaction
                     # already dropped the log prefix, so recompute is impossible)
                     raise RuntimeError(
                         "operator_persisting: persisted snapshots were taken "
                         f"with {self.opsnap.stored_workers()} worker(s) but "
-                        f"this run has {len(self._worker_nodes)}; restart with "
+                        f"this run has {self._total_workers}; restart with "
                         "the same worker count or clear the persistence storage"
                     )
                 if not self.opsnap.validate(self._node_names):
@@ -370,6 +405,8 @@ class Persistence:
                     )
                 offsets = dict(self.opsnap.manifest["input_offsets"])
                 self.opsnap.restore(self._worker_nodes)
+        if self._is_cluster and self._pid != 0:
+            return  # sources poll only on process 0; peers hold no input logs
         # pid stability: a source keeps its snapshots across unrelated pipeline
         # edits — use the connector's name alone when unique among sources, and
         # only disambiguate same-named sources by their order among sources
@@ -417,35 +454,64 @@ class Persistence:
         for p in self.inputs:
             p.trim(offsets[p.pid])
 
+    def _save_operators_cluster(self, time: int) -> None:
+        """Cross-process snapshot (the reference's per-worker persist wrappers
+        + finalized-time consensus, ``persist.rs:843`` / ``state.rs:291``):
+        every process writes its local worker shards for the current
+        generation, a barrier proves all shards are durable, then process 0
+        alone commits the manifest — so a crash mid-save leaves the previous
+        generation authoritative on every process."""
+        assert self.opsnap is not None
+        self.opsnap.save_shards(self._worker_nodes)
+        self.runtime._barrier(("persist_done", True), lambda reports: {"ok": True})
+        if self._pid == 0:
+            offsets = {p.pid: p.consumed() for p in self.inputs}
+            self.opsnap.commit(
+                self._node_names, offsets, time, self._total_workers
+            )
+            for p in self.inputs:
+                p.trim(offsets[p.pid])
+        self.opsnap.advance()
+
     def on_tick_done(self, time: int) -> None:
         for p in self.inputs:
             p.flush()
-        if self.operator_mode and self.opsnap is not None and self.opsnap.due():
-            self._save_operators(time)
+        if not self.operator_mode or self.opsnap is None:
+            return
+        if not self._is_cluster:
+            if self.opsnap.due():
+                self._save_operators(time)
+            return
+        if self.opsnap.interval_s <= 0:
+            return  # snapshot-at-close only: skip the per-tick barrier
+            # (config is identical on every process, so the skip is symmetric)
+        # cluster: process 0 decides due-ness (monotonic clocks differ across
+        # processes) and the decision broadcasts over the barrier — every
+        # process calls the same barrier sequence every tick
+        due = self.opsnap.due() if self._pid == 0 else False
+        decision = self.runtime._barrier(
+            ("persist", due), lambda reports: {"do": reports[0][1]}
+        )
+        if decision["do"]:
+            self._save_operators_cluster(time)
 
     def on_close(self) -> None:
         for p in self.inputs:
             p.flush()
-        if self.operator_mode and self.opsnap is not None:
+        if not self.operator_mode or self.opsnap is None:
+            return
+        if not self._is_cluster:
             self._save_operators(-1)
+            return
+        # forced final snapshot: every operator-mode process reaches on_close
+        # in lockstep and _save_operators_cluster carries its own barrier
+        self._save_operators_cluster(-1)
 
 
 def attach(runtime, config) -> None:
-    from pathway_tpu.engine.runtime import Runtime as _SingleRuntime
-    from pathway_tpu.parallel.sharded import ShardedRuntime as _ShardedRuntime
-
-    if config.persistence_mode == "operator_persisting" and type(runtime) not in (
-        _SingleRuntime,
-        _ShardedRuntime,
-    ):
-        # the multi-process cluster runtime has no shared storage view or
-        # cross-process quiesce hook yet; sharded (threads) snapshots every
-        # worker's shards per generation (see _OperatorSnapshots.save)
-        raise NotImplementedError(
-            "operator_persisting currently requires a single-process runtime "
-            "(PATHWAY_PROCESSES=1; any PATHWAY_THREADS); use the default "
-            "input-snapshot mode for multi-process runs"
-        )
+    """All runtimes support operator persistence: single, thread-sharded
+    (per-worker shards), and the multi-process cluster (per-process shard
+    writes over the shared backend + barrier-consensus manifest commit)."""
     runtime.persistence = Persistence(config, runtime)
     if config.backend.kind == "filesystem" and config.backend.path:
         # colocate UDF DiskCache with the persistent storage (reference:

@@ -122,23 +122,22 @@ def test_sharded_terminate_on_error_aborts():
         pw.run(n_workers=2)
 
 
-def test_operator_persisting_refused_on_cluster():
-    """Sharded (threads) now snapshots per worker; only the multi-process
-    cluster runtime — no shared storage view — still refuses operator mode."""
+def test_operator_persisting_attaches_to_cluster():
+    """Every runtime supports operator persistence now — the cluster runtime
+    coordinates per-process shard writes over the shared backend (see
+    tests/test_cluster.py for the end-to-end restart test)."""
     from pathway_tpu.parallel.cluster import ClusterRuntime
     from pathway_tpu.persistence.snapshots import attach
 
-    # the real type, uninitialized: attach's guard is a type check and must
-    # fire before any runtime state is touched
     rt = ClusterRuntime.__new__(ClusterRuntime)
-    with pytest.raises(NotImplementedError, match="single-process"):
-        attach(
-            rt,
-            pw.persistence.Config(
-                backend=pw.persistence.Backend.memory(),
-                persistence_mode="operator_persisting",
-            ),
-        )
+    attach(
+        rt,
+        pw.persistence.Config(
+            backend=pw.persistence.Backend.memory(),
+            persistence_mode="operator_persisting",
+        ),
+    )
+    assert rt.persistence.operator_mode
 
 
 def test_error_carries_user_provenance():
