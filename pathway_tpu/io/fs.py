@@ -81,13 +81,16 @@ def read(
             schema = schema_mod.schema_from_types(data=bytes)
         else:
             raise ValueError("schema required for csv/json formats")
+    base_schema = schema  # parse with the DATA columns only; the _metadata
+    # column is appended afterwards (parsing with the merged schema would bind
+    # a placeholder parsed from the payload instead of the real metadata)
     if with_metadata:
         schema = schema | schema_mod.schema_from_types(_metadata=dict)
 
     if mode == "static":
         all_rows: list[tuple] = []
         for fpath in _list_files(path):
-            rows = _parse_file(fpath, format, schema, csv_settings)
+            rows = _parse_file(fpath, format, base_schema, csv_settings)
             if with_metadata:
                 rows = [r + (_metadata_for(fpath),) for r in rows]
             all_rows.extend(rows)
@@ -113,7 +116,7 @@ def read(
                         continue
                     self._seen[fpath] = mtime
                     found = True
-                    for r in _parse_file(fpath, format, schema, csv_settings):
+                    for r in _parse_file(fpath, format, base_schema, csv_settings):
                         if with_metadata:
                             r = r + (_metadata_for(fpath),)
                         self.next(**dict(zip(schema.column_names(), r)))

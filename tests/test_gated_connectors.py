@@ -563,3 +563,240 @@ def test_kafka_offset_state_preserves_row_key_counter(tmp_path):
     # 6 distinct rows -> 6 distinct keys (no key reuse after restart)
     assert sorted(s2.values()) == [f"a{i}" for i in range(6)]
     assert len(s2) == 6
+
+
+# ------------------------------------------------------- logstash (stdlib http)
+def test_logstash_write_posts_flat_json():
+    import http.server
+    import socketserver
+
+    received = []
+
+    class Handler(http.server.BaseHTTPRequestHandler):
+        def do_POST(self):
+            n = int(self.headers["Content-Length"])
+            received.append(json.loads(self.rfile.read(n)))
+            self.send_response(200)
+            self.end_headers()
+            self.wfile.write(b"ok")
+
+        def log_message(self, *a):
+            pass
+
+    srv = socketserver.TCPServer(("127.0.0.1", 0), Handler)
+    port = srv.server_address[1]
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    try:
+        G.clear()
+        t = pw.debug.table_from_rows(
+            pw.schema_from_types(w=str, n=int), [("a", 1), ("b", 2)]
+        )
+        pw.io.logstash.write(t, f"http://127.0.0.1:{port}/")
+        pw.run(monitoring_level="none")
+    finally:
+        srv.shutdown()
+        srv.server_close()
+    assert sorted((r["w"], r["n"], r["diff"]) for r in received) == [
+        ("a", 1, 1),
+        ("b", 2, 1),
+    ]
+    assert all("time" in r for r in received)
+
+
+# ------------------------------------------------------- pubsub (fake client)
+class FakePublisher:
+    def __init__(self):
+        self.published = []
+
+    def topic_path(self, project, topic):
+        return f"projects/{project}/topics/{topic}"
+
+    def publish(self, topic_path, data, **attrs):
+        self.published.append((topic_path, data, attrs))
+
+        class F:
+            def result(self):
+                return "msg-id"
+
+        return F()
+
+
+def test_pubsub_write():
+    pub = FakePublisher()
+    G.clear()
+    t = pw.debug.table_from_rows(pw.schema_from_types(data=bytes), [(b"x",), (b"y",)])
+    pw.io.pubsub.write(t, pub, "proj", "topic")
+    pw.run(monitoring_level="none")
+    assert sorted(d for _p, d, _a in pub.published) == [b"x", b"y"]
+    assert all(p == "projects/proj/topics/topic" for p, _d, _a in pub.published)
+    assert all(a["pathway_diff"] == "1" for _p, _d, a in pub.published)
+    G.clear()
+    t2 = pw.debug.table_from_rows(pw.schema_from_types(a=int, b=int), [(1, 2)])
+    with pytest.raises(ValueError, match="one"):
+        pw.io.pubsub.write(t2, pub, "proj", "topic")
+
+
+# --------------------------------------------------- pyfilesystem (fake FS)
+class FakeFS:
+    """PyFilesystem-shaped in-memory FS (listdir/isdir/readbytes/getinfo)."""
+
+    def __init__(self):
+        self.files: dict[str, bytes] = {}
+        self.mtimes: dict[str, int] = {}
+
+    def put(self, path, data, mtime=1):
+        self.files[path] = data
+        self.mtimes[path] = mtime
+
+    def listdir(self, path):
+        path = path.rstrip("/") or "/"
+        seen = set()
+        for f in self.files:
+            if f.startswith(path + "/" if path != "/" else "/"):
+                rest = f[len(path) :].lstrip("/")
+                seen.add(rest.split("/")[0])
+        return sorted(seen)
+
+    def isdir(self, path):
+        return path not in self.files and any(
+            f.startswith(path.rstrip("/") + "/") for f in self.files
+        )
+
+    def readbytes(self, path):
+        return self.files[path]
+
+    def getinfo(self, path, namespaces=()):
+        class I:
+            raw = {"details": {"modified": self.mtimes.get(path)}}
+            modified = self.mtimes.get(path)
+
+        return I()
+
+
+def test_pyfilesystem_static_read():
+    fs = FakeFS()
+    fs.put("/docs/a.jsonl", b'{"v": 1}\n{"v": 2}\n')
+    fs.put("/docs/sub/b.jsonl", b'{"v": 3}\n')
+    G.clear()
+    t = pw.io.pyfilesystem.read(
+        fs, "/docs", format="json", schema=pw.schema_from_types(v=int), mode="static"
+    )
+    assert sorted(rows_of(t)) == [(1,), (2,), (3,)]
+
+
+def test_pyfilesystem_streaming_modify_retracts():
+    fs = FakeFS()
+    fs.put("/d/x.txt", b"one\n", mtime=1)
+    G.clear()
+    t = pw.io.pyfilesystem.read(
+        fs, "/d", format="plaintext", mode="streaming", refresh_interval=0.05
+    )
+    state = {}
+    pw.io.subscribe(
+        t,
+        on_change=lambda key, row, time, is_addition: state.__setitem__(key, row["data"])
+        if is_addition
+        else state.pop(key, None),
+    )
+
+    def later():
+        time.sleep(0.3)
+        fs.put("/d/x.txt", b"two\n", mtime=2)  # replaced, not added
+        time.sleep(0.4)
+        rt = pw.internals.run.current_runtime()
+        if rt is not None:
+            rt.request_stop()
+
+    threading.Thread(target=later, daemon=True).start()
+    pw.run(monitoring_level="none")
+    assert sorted(state.values()) == ["two"]  # old version retracted
+
+
+# ------------------------------------------------------- nats (fake client)
+class FakeNats:
+    """client_factory surface: connect -> conn with subscribe/publish/close."""
+
+    def __init__(self):
+        self.topics: dict[str, list[bytes]] = {}
+        self.closed = 0
+
+    def connect(self, uri):
+        mod = self
+
+        class Conn:
+            def subscribe(self, topic):
+                pos = 0
+                while True:
+                    msgs = mod.topics.get(topic, [])
+                    if pos < len(msgs):
+                        yield msgs[pos]
+                        pos += 1
+                    else:
+                        yield None
+
+            def publish(self, topic, payload):
+                mod.topics.setdefault(topic, []).append(payload)
+
+            def close(self):
+                mod.closed += 1
+
+        return Conn()
+
+
+def test_nats_write_then_read_json():
+    fake = FakeNats()
+    G.clear()
+    t = pw.debug.table_from_rows(pw.schema_from_types(w=str, n=int), [("a", 1), ("b", 2)])
+    pw.io.nats.write(t, "nats://fake:4222", "out", format="json", client_factory=fake)
+    pw.run(monitoring_level="none")
+    assert len(fake.topics["out"]) == 2
+
+    G.clear()
+    r = pw.io.nats.read(
+        "nats://fake:4222",
+        "out",
+        format="json",
+        schema=pw.schema_from_types(w=str, n=int),
+        client_factory=fake,
+    )
+    got = []
+    pw.io.subscribe(
+        r, on_change=lambda key, row, time, is_addition: got.append((row["w"], row["n"]))
+    )
+
+    def stopper():
+        time.sleep(0.5)
+        rt = pw.internals.run.current_runtime()
+        if rt is not None:
+            rt.request_stop()
+
+    threading.Thread(target=stopper, daemon=True).start()
+    pw.run(monitoring_level="none")
+    assert sorted(got) == [("a", 1), ("b", 2)]
+    deadline = time.time() + 2
+    while fake.closed < 1 and time.time() < deadline:
+        time.sleep(0.02)  # the subject thread closes asynchronously after stop
+    assert fake.closed >= 1
+
+
+def test_nats_read_plaintext():
+    fake = FakeNats()
+    fake.topics["words"] = [b"hello", b"world"]
+    G.clear()
+    r = pw.io.nats.read(
+        "nats://fake:4222", "words", format="plaintext", client_factory=fake
+    )
+    got = []
+    pw.io.subscribe(
+        r, on_change=lambda key, row, time, is_addition: got.append(row["data"])
+    )
+
+    def stopper():
+        time.sleep(0.4)
+        rt = pw.internals.run.current_runtime()
+        if rt is not None:
+            rt.request_stop()
+
+    threading.Thread(target=stopper, daemon=True).start()
+    pw.run(monitoring_level="none")
+    assert sorted(got) == ["hello", "world"]
