@@ -157,8 +157,15 @@ def write(
 ) -> None:
     from pathway_tpu.io._format import formatter_for
 
+    if format not in ("json", "raw", "plaintext"):
+        raise ValueError(f"unknown NATS format {format!r}")
     factory = _client(kwargs)
     cols = table.column_names()
+    if format != "json" and len(cols) != 1:
+        raise ValueError(
+            f"NATS {format!r} write requires a single-column table; "
+            f"got {len(cols)} columns (use format='json')"
+        )
     fmt = formatter_for("json", cols) if format == "json" else None
     conn_holder: dict = {}
 
