@@ -115,29 +115,43 @@ def write(table: Table, uri: str, *, name: str | None = None, **kwargs: Any) -> 
                     },
                 ] + actions
             payload = "\n".join(_json.dumps(a) for a in acts) + "\n"
+            # write the FULL content to a temp file, then atomically link it
+            # into place — put-if-absent with content, so a concurrent reader
+            # can never observe an empty/truncated version file
+            tmp = _log_path(uri, version) + f".tmp{os.getpid()}"
+            with open(tmp, "w") as fh:
+                fh.write(payload)
             try:
-                fd = os.open(
-                    _log_path(uri, version), os.O_WRONLY | os.O_CREAT | os.O_EXCL
-                )
+                os.link(tmp, _log_path(uri, version))
             except FileExistsError:
+                os.unlink(tmp)
                 state["version"] = max(_existing_versions(uri), default=-1)
                 continue
-            with os.fdopen(fd, "w") as fh:
-                fh.write(payload)
+            os.unlink(tmp)
             return
 
+    # columns whose dtype maps to Delta "string" get stringified at write so
+    # the parquet column type always matches the declared schemaString
+    stringly = {
+        c
+        for c in cols
+        if _delta_type(dtypes.get(c, dt.STR)) == "string"
+    }
+
     def on_batch(batch, columns) -> None:
-        arrays: dict[str, list] = {c: [] for c in cols}
-        times, diffs = [], []
-        for _key, diff, row in batch.rows():
-            for c, v in zip(cols, row):
-                arrays[c].append(v)
-            times.append(batch.time)
-            diffs.append(diff)
-        if not times:
+        from pathway_tpu.engine.blocks import column_to_list
+
+        n = len(batch)
+        if not n:
             return
-        arrays["time"] = times
-        arrays["diff"] = diffs
+        arrays: dict[str, list] = {}
+        for c in cols:  # one C-speed pass per column, no per-row loop
+            vals = column_to_list(batch.data[c])
+            if c in stringly:
+                vals = [None if v is None else str(v) for v in vals]
+            arrays[c] = vals
+        arrays["time"] = [batch.time] * n
+        arrays["diff"] = batch.diffs.tolist()
         part = f"part-{state['version'] + 1:05d}-{uuid.uuid4()}.snappy.parquet"
         fpath = os.path.join(uri, part)
         pq.write_table(pa.table(arrays), fpath)
@@ -177,14 +191,11 @@ def _version_rows(uri: str, version: int, schema_cols: list[str]) -> list[tuple]
                 t = pq.read_table(os.path.join(uri, action["add"]["path"]))
                 data = {c: t.column(c).to_pylist() for c in t.column_names}
                 n = t.num_rows
-                diffs = data.get("diff", [1] * n)
-                for i in range(n):
-                    rows.append(
-                        (
-                            tuple(data.get(c, [None] * n)[i] for c in schema_cols),
-                            int(diffs[i]),
-                        )
-                    )
+                diffs = data.get("diff") or [1] * n
+                col_lists = [data.get(c) or [None] * n for c in schema_cols]
+                rows.extend(
+                    zip(zip(*col_lists) if col_lists else [()] * n, map(int, diffs))
+                )
     return rows
 
 
@@ -198,6 +209,8 @@ def read(
     **kwargs: Any,
 ) -> Table:
     cols = schema.column_names()
+    if mode not in ("static", "streaming"):
+        raise ValueError(f"unknown deltalake read mode {mode!r}")
 
     if mode == "static":
         from pathway_tpu.io.fs import _keys_for
@@ -214,7 +227,10 @@ def read(
         return table_from_static_data(keys, all_rows, schema)
 
     from pathway_tpu.internals.keys import stable_hash_obj
+    from pathway_tpu.io.fs import _keys_for
     from pathway_tpu.io.python import ConnectorSubject, read as py_read
+
+    pks = schema.primary_key_columns()
 
     class _DeltaSubject(ConnectorSubject):
         def __init__(self) -> None:
@@ -231,12 +247,24 @@ def read(
                 found = False
                 for v in versions:
                     found = True
-                    for values, diff in _version_rows(uri, v, cols):
-                        # content-derived key: a replayed retraction must net
+                    vrows = _version_rows(uri, v, cols)
+                    if not vrows:
+                        self._next_version = v + 1
+                        continue
+                    values_list = [r for r, _d in vrows]
+                    if pks:
+                        # primary-key keys: streaming and static modes agree,
+                        # and updates surface as in-place retract+insert
+                        keys_ = _keys_for(values_list, schema, salt=0)
+                    else:
+                        # content-derived: a replayed retraction must net
                         # against its insert (sequential keys never match)
-                        key = int(stable_hash_obj(values))
-                        assert self._node is not None
-                        self._node.push(key, values, diff)
+                        keys_ = [int(stable_hash_obj(r)) for r in values_list]
+                    assert self._node is not None
+                    self._node.push_many(
+                        (int(k), r, d)
+                        for k, (r, d) in zip(keys_, vrows)
+                    )
                     self._next_version = v + 1
                 if self._bounded and not found:
                     return
