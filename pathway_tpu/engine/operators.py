@@ -1225,16 +1225,17 @@ class SubscribeNode(Node):
         batches, self._pending = self._pending, []
         merged = concat_batches(batches)
         net = consolidate(merged) if merged is not None else None
-        fired = False
-        if net is not None and len(net):
-            fired = True
-            if self.on_change is not None:
-                for key, diff, row in net.rows():
-                    row_dict = dict(zip(self.columns, row))
-                    self.on_change(
-                        key=key, row=row_dict, time=time, is_addition=diff > 0
-                    )
-        if fired and self.on_time_end is not None and time != END_OF_STREAM:
+        if net is not None and len(net) and self.on_change is not None:
+            for key, diff, row in net.rows():
+                row_dict = dict(zip(self.columns, row))
+                self.on_change(
+                    key=key, row=row_dict, time=time, is_addition=diff > 0
+                )
+        # on_time_end is a per-time commit signal: it fires whenever raw data
+        # arrived this tick, even if consolidation nets to zero (a retract +
+        # re-insert of identical rows still marks the time as processed);
+        # only on_change is gated on the net batch
+        if self.on_time_end is not None and time != END_OF_STREAM:
             self.on_time_end(time)
 
     def on_end(self):

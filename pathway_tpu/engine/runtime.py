@@ -100,6 +100,10 @@ class Runtime:
         finally:
             for driver in self.connectors:
                 driver.stop()
+        # a subject may error and close between the failure check and the
+        # all(is_finished) break within one iteration — re-check so the run
+        # can't exit cleanly on silently truncated input
+        check_connector_failures(self.connectors)
         scheduler.close()
         if self.persistence is not None:
             self.persistence.on_close()

@@ -57,20 +57,40 @@ class ExportedTable:
 
     def snapshot_at(self, frontier: int | None = None) -> list[tuple[int, tuple]]:
         """Consolidated live rows at ``frontier`` (default: everything),
-        sorted by key — ``ExportedTable::snapshot_at`` semantics."""
-        net: dict[int, tuple[tuple, int]] = {}
+        sorted by key — ``ExportedTable::snapshot_at`` semantics.
+
+        Nets on (key, values) pairs like engine consolidation (advisor r4): a
+        key holding several distinct value tuples keeps each with its own
+        multiplicity, and a retraction for values never inserted can't pin
+        those values into the snapshot. Rows with multiplicity m appear m
+        times, matching the engine's multiset semantics."""
+        # values tuples may hold unhashable cells (ndarray columns) — net on a
+        # hashable digest, keep the original tuple for the result
+        def hkey(values: tuple):
+            try:
+                hash(values)
+                return values
+            except TypeError:
+                from pathway_tpu.internals.keys import stable_hash_obj
+
+                return ("__digest__", int(stable_hash_obj(values)))
+
+        net: dict[tuple[int, Any], list] = {}  # (key, digest) -> [values, count]
         with self._lock:
             rows = list(self._rows)
         for key, values, t, diff in rows:
             if frontier is not None and t > frontier:
                 continue
-            old_vals, old_diff = net.get(key, (values, 0))
-            if diff > 0:
-                net[key] = (values, old_diff + diff)
+            hk = (key, hkey(values))
+            ent = net.get(hk)
+            if ent is None:
+                net[hk] = [values, diff]
             else:
-                net[key] = (old_vals, old_diff + diff)
+                ent[1] += diff
+        # stable sort by key only: value tuples may be incomparable (None vs int)
         return sorted(
-            (key, vals) for key, (vals, d) in net.items() if d > 0
+            ((key, vals) for (key, _), (vals, d) in net.items() if d > 0 for _ in range(d)),
+            key=lambda r: r[0],
         )
 
     # -- writer surface (ExportNode only) ------------------------------------

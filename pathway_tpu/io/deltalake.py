@@ -131,7 +131,8 @@ def write(table: Table, uri: str, *, name: str | None = None, **kwargs: Any) -> 
             return
 
     # columns whose dtype maps to Delta "string" get stringified at write so
-    # the parquet column type always matches the declared schemaString
+    # the parquet column type always matches the declared schemaString;
+    # _stringify writes the canonical form that read()'s _coerce_back parses
     stringly = {
         c
         for c in cols
@@ -148,7 +149,7 @@ def write(table: Table, uri: str, *, name: str | None = None, **kwargs: Any) -> 
         for c in cols:  # one C-speed pass per column, no per-row loop
             vals = column_to_list(batch.data[c])
             if c in stringly:
-                vals = [None if v is None else str(v) for v in vals]
+                vals = [_stringify(v) for v in vals]
             arrays[c] = vals
         arrays["time"] = [batch.time] * n
         arrays["diff"] = batch.diffs.tolist()
@@ -176,10 +177,98 @@ def write(table: Table, uri: str, *, name: str | None = None, **kwargs: Any) -> 
     )._register_as_output()
 
 
-def _version_rows(uri: str, version: int, schema_cols: list[str]) -> list[tuple]:
-    """(values-tuple, diff) rows added by one commit version."""
+def _plain(v):
+    """Numpy-free canonical form of a cell for tuple stringification: the
+    result's ``repr`` must survive ``ast.literal_eval`` (numpy-2 scalar reprs
+    like ``np.int64(1)`` do not)."""
+    import numpy as np
+
+    if isinstance(v, np.datetime64):
+        return str(v.astype("datetime64[ns]"))
+    if isinstance(v, np.timedelta64):
+        return int(v.astype("timedelta64[ns]").astype(np.int64))
+    if isinstance(v, np.generic):
+        return v.item()
+    if isinstance(v, (tuple, list)):
+        return tuple(_plain(e) for e in v)
+    return v
+
+
+def _stringify(v) -> str | None:
+    """Canonical string form for a Delta 'string'-typed cell, chosen so
+    ``_coerce_back`` can recover the original value: durations as integer
+    nanoseconds, datetimes as numpy ISO, Json as compact JSON, tuples as
+    literal_eval-able reprs of plain-Python elements."""
+    import numpy as np
+
+    if v is None:
+        return None
+    if isinstance(v, np.timedelta64):
+        return str(int(v.astype("timedelta64[ns]").astype(np.int64)))
+    if isinstance(v, (tuple, list)):
+        return repr(_plain(v))
+    return str(v)
+
+
+def _make_coercer(d):
+    """One converter per column dtype (not per cell): the inverse of the
+    write-side stringification (advisor r4: round-trips must not silently
+    yield str where the schema says datetime/duration/tuple/JSON)."""
+    import numpy as np
+
+    from pathway_tpu.io._format import coerce_scalar
+
+    d = dt.unoptionalize(d)
+    if d in (dt.DATE_TIME_NAIVE, dt.DATE_TIME_UTC):
+        def conv(v):
+            try:
+                return np.datetime64(v)
+            except ValueError:
+                return v
+        return conv
+    if d == dt.DURATION:
+        def conv(v):
+            try:
+                return np.timedelta64(int(v), "ns")
+            except (ValueError, TypeError):
+                return v
+        return conv
+    if isinstance(d, dt.Tuple):
+        import ast
+
+        # element-wise coercers for fixed-arity tuples (ANY_TUPLE has none)
+        elem_convs = [_make_coercer(a) for a in d.args] if d.args else None
+
+        def conv(v):
+            try:
+                parsed = ast.literal_eval(v)
+            except (ValueError, SyntaxError):
+                return v
+            if not isinstance(parsed, (tuple, list)):
+                return v
+            parsed = tuple(parsed)
+            if elem_convs is not None and len(elem_convs) == len(parsed):
+                return tuple(c(e) for c, e in zip(elem_convs, parsed))
+            return parsed
+        return conv
+    return lambda v: coerce_scalar(v, d)
+
+
+def _version_rows(
+    uri: str, version: int, schema_cols: list[str], dtypes: dict | None = None
+) -> list[tuple]:
+    """(values-tuple, diff) rows added by one commit version, coerced back to
+    the declared schema dtypes."""
     import pyarrow.parquet as pq
 
+    dtypes = dtypes or {}
+    # columns needing value coercion (everything Delta stores as 'string'
+    # except true STR columns, plus ints/floats pyarrow may widen)
+    coercers = {
+        c: _make_coercer(dtypes[c])
+        for c in schema_cols
+        if c in dtypes and dt.unoptionalize(dtypes[c]) not in (dt.STR, dt.ANY)
+    }
     rows: list[tuple] = []
     with open(_log_path(uri, version)) as fh:
         for line in fh:
@@ -193,6 +282,12 @@ def _version_rows(uri: str, version: int, schema_cols: list[str]) -> list[tuple]
                 n = t.num_rows
                 diffs = data.get("diff") or [1] * n
                 col_lists = [data.get(c) or [None] * n for c in schema_cols]
+                for c_idx, c in enumerate(schema_cols):
+                    conv = coercers.get(c)
+                    if conv is not None and data.get(c) is not None:
+                        col_lists[c_idx] = [
+                            None if v is None else conv(v) for v in col_lists[c_idx]
+                        ]
                 rows.extend(
                     zip(zip(*col_lists) if col_lists else [()] * n, map(int, diffs))
                 )
@@ -218,7 +313,7 @@ def read(
         net: dict[tuple, int] = {}
         order: list[tuple] = []
         for v in _existing_versions(uri):
-            for r, d in _version_rows(uri, v, cols):
+            for r, d in _version_rows(uri, v, cols, schema.dtypes()):
                 if r not in net:
                     order.append(r)
                 net[r] = net.get(r, 0) + d
@@ -247,7 +342,7 @@ def read(
                 found = False
                 for v in versions:
                     found = True
-                    vrows = _version_rows(uri, v, cols)
+                    vrows = _version_rows(uri, v, cols, schema.dtypes())
                     if not vrows:
                         self._next_version = v + 1
                         continue

@@ -123,6 +123,7 @@ class _SubjectDriver:
         self.subject = subject
         self.thread: threading.Thread | None = None
         self.error: BaseException | None = None
+        self._error_pre_stop = False
         self._stopped = False
 
     def start(self) -> None:
@@ -130,6 +131,7 @@ class _SubjectDriver:
             try:
                 self.subject.run()
             except BaseException as e:  # noqa: BLE001 — transported to the run loop
+                self._error_pre_stop = not self._stopped
                 self.error = e
             finally:
                 self.subject.close()
@@ -138,9 +140,11 @@ class _SubjectDriver:
         self.thread.start()
 
     def failure(self) -> BaseException | None:
-        # errors after a requested stop (e.g. a socket torn down mid-read)
-        # are shutdown noise, not pipeline failures
-        return None if self._stopped else self.error
+        # errors raised after a requested stop (e.g. a socket torn down
+        # mid-read) are shutdown noise, not pipeline failures; errors raised
+        # before the stop stay visible even once stop() runs in the finally
+        # block, so the run loop's post-loop check can still surface them
+        return self.error if self._error_pre_stop else None
 
     def is_finished(self) -> bool:
         node = self.subject._node

@@ -596,6 +596,12 @@ class ClusterRuntime:
             if self.pid == 0:
                 for driver in self.connectors:
                     driver.stop()
+        if self.pid == 0:
+            # re-check: a subject may error between the in-loop check and the
+            # is_finished break (see engine.runtime.Runtime.run)
+            from pathway_tpu.engine.runtime import check_connector_failures
+
+            check_connector_failures(self.connectors)
         self.close()
         return self
 

@@ -242,6 +242,11 @@ class ShardedRuntime:
         finally:
             for driver in self.connectors:
                 driver.stop()
+        # re-check: a subject may error between the in-loop check and the
+        # is_finished break (see engine.runtime.Runtime.run)
+        from pathway_tpu.engine.runtime import check_connector_failures
+
+        check_connector_failures(self.connectors)
         self.close()
         return self
 

@@ -1,0 +1,216 @@
+"""Regression tests for the round-4 advisor findings (ADVICE.md r4).
+
+1. Connector-failure race: an error raised before stop stays visible after
+   ``driver.stop()`` runs, and the run loop re-checks failures after exiting.
+2. ``SubscribeNode.on_time_end`` fires for ticks whose changes fully cancel
+   (retract + insert of identical rows) — it is a per-time commit signal.
+3. Delta Lake write→read round-trips non-primitive dtypes (datetime, duration,
+   tuple, JSON) back to their declared schema types.
+4. ``ExportedTable.snapshot_at`` nets on (key, values) pairs, handling multiset
+   keys and early retractions like engine consolidation.
+"""
+
+from __future__ import annotations
+
+import time
+
+import numpy as np
+import pytest
+
+import pathway_tpu as pw
+from pathway_tpu.internals.parse_graph import G
+from utils import rows_of
+
+
+# ---------------------------------------------------------------- finding 1
+def test_driver_failure_visible_after_stop():
+    from pathway_tpu.io.python import ConnectorSubject, _SubjectDriver
+
+    class Boom(ConnectorSubject):
+        def run(self):
+            raise ValueError("pre-stop failure")
+
+    d = _SubjectDriver(Boom())
+    d.start()
+    d.thread.join(timeout=5)
+    assert d.failure() is not None
+    d.stop()  # the run loop's finally block
+    # the pre-stop error must survive stop() so the post-loop check sees it
+    assert isinstance(d.failure(), ValueError)
+
+
+def test_driver_post_stop_error_is_shutdown_noise():
+    import threading
+
+    from pathway_tpu.io.python import ConnectorSubject, _SubjectDriver
+
+    release = threading.Event()
+
+    class DiesOnStop(ConnectorSubject):
+        def run(self):
+            release.wait(timeout=5)
+            raise OSError("socket torn down mid-read")
+
+    d = _SubjectDriver(DiesOnStop())
+    d.start()
+    d.stop()
+    release.set()
+    d.thread.join(timeout=5)
+    assert d.failure() is None  # raised after stop: not a pipeline failure
+
+
+def test_run_surfaces_error_raised_at_finish():
+    """A subject that pushes rows then errors must fail the run even if the
+    error lands in the same iteration as the is_finished break."""
+
+    class Subj(pw.io.python.ConnectorSubject):
+        def run(self):
+            self.next(x=1)
+            raise RuntimeError("exploded after the last row")
+
+    G.clear()
+    t = pw.io.python.read(Subj(), schema=pw.schema_from_types(x=int))
+    pw.io.subscribe(t, on_change=lambda **k: None)
+    with pytest.raises(RuntimeError, match="connector failed"):
+        pw.run(monitoring_level="none")
+
+
+# ---------------------------------------------------------------- finding 2
+def test_on_time_end_fires_on_fully_cancelling_tick():
+    """A raw batch whose rows net to zero under consolidation (retract +
+    insert of identical rows in one tick) must still fire on_time_end — it is
+    a per-time commit signal — while on_change stays silent."""
+    from pathway_tpu.engine.blocks import DeltaBatch
+    from pathway_tpu.engine.operators import SubscribeNode
+
+    times, changes = [], []
+    node = SubscribeNode(
+        ["w", "n"],
+        on_change=lambda key, row, time, is_addition: changes.append(time),
+        on_time_end=lambda time: times.append(time),
+    )
+    batch = DeltaBatch.from_rows(
+        [7, 7], [("a", 1), ("a", 1)], ["w", "n"], 3, diffs=[1, -1]
+    )
+    node.process([batch], 3)
+    node.on_tick_complete(3)
+    assert times == [3]  # commit signal fires though the tick netted to zero
+    assert changes == []  # no spurious on_change
+    # and a tick with NO raw data stays silent
+    node.on_tick_complete(4)
+    assert times == [3]
+
+
+# ---------------------------------------------------------------- finding 3
+def test_deltalake_round_trips_non_primitive_dtypes(tmp_path):
+    uri = str(tmp_path / "dtable")
+    G.clear()
+    ts = np.datetime64("2024-06-01T12:34:56.000000789", "ns")
+    dur = np.timedelta64(90, "m").astype("timedelta64[ns]")
+    tup = ("x", 7)
+    t = pw.debug.table_from_rows(
+        pw.schema_from_types(
+            w=str,
+            ts=pw.DateTimeNaive,
+            dur=pw.Duration,
+            tup=tuple[str, int],
+            j=pw.Json,
+        ),
+        [("a", ts, dur, tup, pw.Json({"k": [1, 2]}))],
+    )
+    pw.io.deltalake.write(t, uri)
+    pw.run(monitoring_level="none")
+
+    G.clear()
+    r = pw.io.deltalake.read(
+        uri,
+        schema=pw.schema_from_types(
+            w=str,
+            ts=pw.DateTimeNaive,
+            dur=pw.Duration,
+            tup=tuple[str, int],
+            j=pw.Json,
+        ),
+        mode="static",
+    )
+    ((row, _count),) = rows_of(r).items()
+    w, got_ts, got_dur, got_tup, got_j = row
+    assert w == "a"
+    assert isinstance(got_ts, np.datetime64) and got_ts == ts
+    assert isinstance(got_dur, np.timedelta64) and got_dur == dur
+    assert got_tup == tup
+    assert got_j.value == {"k": [1, 2]}
+
+
+# ---------------------------------------------------------------- finding 4
+def test_snapshot_at_multiset_keys():
+    from pathway_tpu.internals.exported import ExportedTable
+
+    ex = ExportedTable(["v"], {"v": int})
+    ex._append(
+        [
+            # key 1 holds two distinct value tuples simultaneously
+            (1, ("x",), 0, 1),
+            (1, ("y",), 0, 1),
+            # key 2: retraction arrives BEFORE any insert; must not pin ("old",)
+            (2, ("old",), 0, -1),
+            (2, ("new",), 1, 1),
+            # key 3: multiplicity 2 of the same tuple
+            (3, ("z",), 1, 2),
+            # key 4: fully retracted
+            (4, ("gone",), 0, 1),
+            (4, ("gone",), 1, -1),
+        ]
+    )
+    snap = ex.snapshot_at()
+    assert snap == sorted(
+        [(1, ("x",)), (1, ("y",)), (2, ("new",)), (3, ("z",)), (3, ("z",))]
+    )
+    # frontier cut: at time 0 key 2 has nothing live and key 4 is live
+    snap0 = ex.snapshot_at(frontier=0)
+    assert snap0 == sorted([(1, ("x",)), (1, ("y",)), (4, ("gone",))])
+
+
+def test_snapshot_at_unhashable_and_incomparable_values():
+    """ndarray cells (unhashable) and None-vs-int tuples (incomparable) must
+    not crash the multiset netting / sort (review r5)."""
+    from pathway_tpu.internals.exported import ExportedTable
+
+    ex = ExportedTable(["v"], {"v": object})
+    arr = np.arange(3)
+    ex._append(
+        [
+            (1, (arr,), 0, 1),
+            (1, (arr.copy(),), 1, -1),  # equal content nets out by digest
+            (5, (None,), 0, 1),
+            (5, (1,), 0, 1),  # same key, incomparable value tuples
+        ]
+    )
+    snap = ex.snapshot_at()
+    assert len(snap) == 2
+    assert {k for k, _ in snap} == {5}
+    assert {v[0] for _, v in snap} == {None, 1}
+
+
+def test_deltalake_tuple_with_numpy_elements_round_trips(tmp_path):
+    """Tuple cells holding numpy scalars / datetimes survive write→read
+    (review r5: plain str() of such tuples is not literal_eval-able)."""
+    uri = str(tmp_path / "dtable")
+    G.clear()
+    tup = (np.int64(7), np.datetime64("2024-01-02T03:04:05", "ns"))
+    t = pw.debug.table_from_rows(
+        pw.schema_from_types(w=str, tup=tuple[int, pw.DateTimeNaive]), [("a", tup)]
+    )
+    pw.io.deltalake.write(t, uri)
+    pw.run(monitoring_level="none")
+
+    G.clear()
+    r = pw.io.deltalake.read(
+        uri,
+        schema=pw.schema_from_types(w=str, tup=tuple[int, pw.DateTimeNaive]),
+        mode="static",
+    )
+    ((row, _count),) = rows_of(r).items()
+    _w, got = row
+    assert got[0] == 7
+    assert isinstance(got[1], np.datetime64) and got[1] == tup[1]
