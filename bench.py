@@ -332,6 +332,61 @@ def bench_engine() -> dict:
     return out
 
 
+def bench_exchange() -> dict:
+    """Host vs device exchange plane (VERDICT r4 #1): a multi-device
+    collective, so it runs as a subprocess on the 8-device virtual CPU mesh
+    (the axon tunnel exposes one real chip)."""
+    import json as _json
+    import subprocess
+    import sys
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = (
+        env.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+    ).strip()
+    env["PYTHONPATH"] = here + os.pathsep + env.get("PYTHONPATH", "")
+    proc = subprocess.run(
+        [sys.executable, os.path.join(here, "benchmarks", "exchange_bench.py")],
+        capture_output=True,
+        text=True,
+        timeout=600,
+        env=env,
+    )
+    if proc.returncode != 0:
+        return {"exchange_error": (proc.stderr or proc.stdout)[-200:]}
+    return _json.loads(proc.stdout.strip().splitlines()[-1])
+
+
+def bench_scaling() -> dict:
+    """1/2/4/8-worker scaling curve, thread + process planes (VERDICT r4 #3).
+    Subprocess-driven; see benchmarks/scaling_bench.py for the 1-core caveat."""
+    import json as _json
+    import subprocess
+    import sys
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = here + os.pathsep + env.get("PYTHONPATH", "")
+    proc = subprocess.run(
+        [sys.executable, os.path.join(here, "benchmarks", "scaling_bench.py")],
+        capture_output=True,
+        text=True,
+        timeout=900,
+        env=env,
+    )
+    if proc.returncode != 0:
+        return {"scaling_error": (proc.stderr or proc.stdout)[-200:]}
+    data = _json.loads(proc.stdout.strip().splitlines()[-1])
+    return {
+        "scaling_times_s": data["scaling_times_s"],
+        "scaling_efficiency": data["speedup_vs_1w"],
+        "scaling_note": data["note"],
+    }
+
+
 def bench_torch_batched_baseline(docs: list[str]) -> float:
     """Honest baseline: batched torch CPU, same architecture, batch=BATCH."""
     import torch
@@ -432,6 +487,14 @@ def main() -> None:
         out.update(bench_engine())
     except Exception as e:
         out["engine_error"] = repr(e)
+    try:
+        out.update(bench_exchange())
+    except Exception as e:
+        out["exchange_error"] = repr(e)[:200]
+    try:
+        out.update(bench_scaling())
+    except Exception as e:
+        out["scaling_error"] = repr(e)[:200]
     try:
         out.update(bench_knn_1m())
     except Exception as e:

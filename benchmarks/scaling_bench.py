@@ -1,0 +1,182 @@
+"""Worker-scaling curve: wordcount + filter/join/groupby at 1/2/4/8 workers,
+thread and process planes (VERDICT r4 #3; reference harness:
+``integration_tests/wordcount/base.py``).
+
+HOST CAVEAT: this image exposes ONE cpu core (`os.cpu_count() == 1`), so no
+configuration can show real speedup — the curve measures the runtime's
+parallelization OVERHEAD (exchange, barriers, per-worker graph copies, TCP
+pickling on the process plane). ``speedup_vs_1w`` ≤ 1 by construction here;
+on a multi-core host the same harness measures real scaling (the thread plane
+parallelizes GIL-releasing numpy/XLA segments, the process plane everything).
+
+Run: ``python benchmarks/scaling_bench.py [--quick]``. Prints one JSON line.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import textwrap
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+N_WC_MSGS = 100_000
+N_REL_ROWS = 400_000
+WORKERS = [1, 2, 4, 8]
+PARTS = 8
+
+_CHILD = textwrap.dedent(
+    """
+    import os, sys, time
+    import numpy as np
+    import pathway_tpu as pw
+
+    pipe = os.environ["PIPE"]
+    if pipe == "wordcount":
+        from pathway_tpu.io.kafka import MockKafkaBroker
+
+        broker = MockKafkaBroker(path=os.environ["BROKER_PATH"])
+        t = pw.io.kafka.read(
+            broker, "words",
+            schema=pw.schema_from_types(w=str), format="json", mode="static",
+        )
+        out = t.groupby(t.w).reduce(t.w, c=pw.reducers.count())
+    else:
+        n = int(os.environ["N_ROWS"])
+        rng = np.random.default_rng(0)
+        t = pw.debug.table_from_rows(
+            pw.schema_from_types(k=int, v=int),
+            list(zip(rng.integers(0, 1000, n).tolist(),
+                     rng.integers(0, 10**6, n).tolist())),
+        )
+        d = pw.debug.table_from_rows(
+            pw.schema_from_types(k=int, b=int), [(i, i * 7) for i in range(1000)]
+        )
+        f = t.filter(t.v % 10 != 0)
+        j = f.join(d, f.k == d.k).select(k=f.k, v=f.v + d.b)
+        out = j.groupby(j.k).reduce(j.k, s=pw.reducers.sum(j.v), c=pw.reducers.count())
+    got = []
+    pw.io.subscribe(out, on_change=lambda **kw: got.append(1))
+    t0 = time.perf_counter()
+    pw.run(monitoring_level="none")
+    print("ELAPSED", time.perf_counter() - t0, flush=True)
+    """
+)
+
+
+def _fill_broker(path: str, n: int) -> None:
+    from pathway_tpu.io.kafka import MockKafkaBroker
+
+    broker = MockKafkaBroker(path=path)
+    broker.create_topic("words", partitions=PARTS)
+    # bulk append per partition (bench setup, not the timed section)
+    import json as _json
+
+    for p in range(PARTS):
+        with open(broker._file("words", p), "a") as fh:
+            fh.writelines(
+                _json.dumps({"k": None, "v": _json.dumps({"w": f"w{i % 501}"})}) + "\n"
+                for i in range(p, n, PARTS)
+            )
+
+
+def _run_child(pipe: str, threads: int, processes: int, env_extra: dict) -> float:
+    """Launch the pipeline; return the slowest process's in-run wall seconds."""
+    with tempfile.TemporaryDirectory() as td:
+        script = os.path.join(td, "child.py")
+        with open(script, "w") as fh:
+            fh.write(_CHILD)
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        env = dict(
+            os.environ,
+            PYTHONPATH=repo,
+            JAX_PLATFORMS="cpu",
+            PIPE=pipe,
+            PATHWAY_THREADS=str(threads),
+            PATHWAY_PROCESSES=str(processes),
+            PATHWAY_BARRIER_TIMEOUT="120",
+            **env_extra,
+        )
+        if processes > 1:
+            env["PATHWAY_FIRST_PORT"] = str(24000 + (os.getpid() + threads) % 20000)
+        procs = []
+        for pid in range(processes):
+            penv = dict(env, PATHWAY_PROCESS_ID=str(pid))
+            procs.append(
+                subprocess.Popen(
+                    [sys.executable, script],
+                    env=penv,
+                    stdout=subprocess.PIPE,
+                    stderr=subprocess.STDOUT,
+                    text=True,
+                )
+            )
+        worst = 0.0
+        for p in procs:
+            out, _ = p.communicate(timeout=900)
+            assert p.returncode == 0, out[-800:]
+            for line in out.splitlines():
+                if line.startswith("ELAPSED"):
+                    worst = max(worst, float(line.split()[1]))
+        return worst
+
+
+def main() -> None:
+    quick = "--quick" in sys.argv
+    n_wc = N_WC_MSGS // 4 if quick else N_WC_MSGS
+    n_rel = N_REL_ROWS // 4 if quick else N_REL_ROWS
+    workers = [1, 2, 4] if quick else WORKERS
+
+    results: dict = {"wordcount": {"thread": {}, "process": {}},
+                     "relational": {"thread": {}, "process": {}}}
+    with tempfile.TemporaryDirectory() as td:
+        broker_path = os.path.join(td, "broker")
+        _fill_broker(broker_path, n_wc)
+        for w in workers:
+            results["wordcount"]["thread"][str(w)] = round(
+                _run_child("wordcount", w, 1, {"BROKER_PATH": broker_path}), 3
+            )
+        for w in workers:
+            results["wordcount"]["process"][str(w)] = round(
+                _run_child("wordcount", 1, w, {"BROKER_PATH": broker_path}), 3
+            )
+    for w in workers:
+        results["relational"]["thread"][str(w)] = round(
+            _run_child("relational", w, 1, {"N_ROWS": str(n_rel)}), 3
+        )
+    for w in workers:
+        results["relational"]["process"][str(w)] = round(
+            _run_child("relational", 1, w, {"N_ROWS": str(n_rel)}), 3
+        )
+
+    eff: dict = {}
+    for pipe, planes in results.items():
+        for plane, times in planes.items():
+            base = times.get("1")
+            eff[f"{pipe}_{plane}"] = {
+                w: round(base / t, 2) if t else None for w, t in times.items()
+            }
+    print(
+        json.dumps(
+            {
+                "metric": "worker scaling curve (wall s in-run, slowest worker)",
+                "n_cores": os.cpu_count(),
+                "wordcount_msgs": n_wc,
+                "relational_rows": n_rel,
+                "scaling_times_s": results,
+                "speedup_vs_1w": eff,
+                "note": "1-core host: curve measures parallelization overhead "
+                "(speedup<=1 by construction); serialization points in "
+                "BASELINE.md §scaling",
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

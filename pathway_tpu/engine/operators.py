@@ -1287,18 +1287,32 @@ class CaptureNode(Node):
 
 
 class CallbackOutputNode(Node):
-    """Generic per-batch sink for io writers."""
+    """Generic per-batch sink for io writers.
+
+    ``sharded=True`` (r5) keeps each row's output on the worker owning its key
+    shard instead of funneling everything to worker 0 — per-worker sink
+    shards with an ordered merge-commit (see ``io/fs.py`` write(sharded=True);
+    reference: per-worker writers, ``worker-architecture.md:36-47``)."""
 
     name = "output"
 
     def exchange_key(self, port):
+        if self.sharded:
+            return lambda batch: batch.keys  # co-locate by row key shard
         return SOLO  # sources/sinks live on worker 0
 
-    def __init__(self, columns: list[str], on_batch: Callable, on_done: Callable | None = None):
+    def __init__(
+        self,
+        columns: list[str],
+        on_batch: Callable,
+        on_done: Callable | None = None,
+        sharded: bool = False,
+    ):
         super().__init__(n_inputs=1)
         self.columns = columns
         self.on_batch = on_batch
         self.on_done = on_done
+        self.sharded = sharded
         self._tick_buffer: list[DeltaBatch] = []
 
     def process(self, inputs, time):

@@ -89,6 +89,22 @@ class PathwayConfig:
         return _env_bool("PATHWAY_IGNORE_ASSERTS", False)
 
     @property
+    def device_exchange(self) -> str:
+        """On-device all_to_all exchange plane for sharded runtimes:
+        ``off`` | ``auto`` (blocks ≥ min_rows ride the mesh) | ``on`` (every
+        eligible batch; byte-identity suites run this)."""
+        mode = os.environ.get("PATHWAY_DEVICE_EXCHANGE", "auto").strip().lower()
+        if mode not in ("off", "auto", "on"):
+            raise ValueError(
+                f"PATHWAY_DEVICE_EXCHANGE must be off/auto/on, got {mode!r}"
+            )
+        return mode
+
+    @property
+    def device_exchange_min_rows(self) -> int:
+        return _env_int("PATHWAY_DEVICE_EXCHANGE_MIN_ROWS", 4096)
+
+    @property
     def monitoring_server(self) -> str | None:
         return os.environ.get("PATHWAY_MONITORING_SERVER")
 

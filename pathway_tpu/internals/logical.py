@@ -43,20 +43,50 @@ class LogicalNode:
         return self
 
 
+#: the BuildContext currently resolving (factories may inspect worker identity
+#: for partitioned sources / sharded sinks); builds are single-threaded per
+#: runtime so a module global suffices
+_CURRENT_BUILD: "BuildContext | None" = None
+
+
+def current_build() -> "BuildContext | None":
+    return _CURRENT_BUILD
+
+
 class BuildContext:
-    def __init__(self, runtime: Any = None):
+    def __init__(
+        self,
+        runtime: Any = None,
+        worker_index: int = 0,
+        n_workers: int = 1,
+        register: Any = None,
+    ):
         self.graph = EngineGraph()
         self.built: dict[int, Node] = {}
         self.build_order: list[tuple[LogicalNode, Node]] = []
         self.runtime = runtime
+        #: which worker this graph copy belongs to / total worker count —
+        #: partitioned sources read disjoint partition sets per worker
+        #: (reference: partition-per-worker Kafka, worker-architecture.md:36-47)
+        self.worker_index = worker_index
+        self.n_workers = n_workers
+        #: connector registration available to EVERY worker's build (the
+        #: runtime hook fires only on the primary build); sharded runtimes
+        #: pass their register_connector so per-worker subjects get drivers
+        self.register = register
         self.hooks: list[tuple[LogicalNode, Node]] = []
 
     def resolve(self, lnode: LogicalNode) -> Node:
+        global _CURRENT_BUILD
         node = self.built.get(id(lnode))
         if node is not None:
             return node
         engine_inputs = [self.resolve(i) for i in lnode.inputs]
-        node = lnode.factory()
+        prev, _CURRENT_BUILD = _CURRENT_BUILD, self
+        try:
+            node = lnode.factory()
+        finally:
+            _CURRENT_BUILD = prev
         node.user_trace = lnode.user_trace
         node.name = lnode.name
         self.graph.add_node(node, engine_inputs)
@@ -72,7 +102,9 @@ class BuildContext:
 
 
 def build_engine_graph(outputs: list[LogicalNode], runtime: Any = None) -> BuildContext:
-    ctx = BuildContext(runtime)
+    ctx = BuildContext(
+        runtime, register=None if runtime is None else runtime.register_connector
+    )
     for out in outputs:
         ctx.resolve(out)
     ctx.finish()

@@ -144,45 +144,37 @@ def _metadata_for(fpath: str) -> Any:
     )
 
 
-def write(table: Table, filename: str, *, format: str = "csv", **kwargs: Any) -> None:  # noqa: A002
+def write(
+    table: Table,
+    filename: str,
+    *,
+    format: str = "csv",  # noqa: A002
+    sharded: bool = False,
+    **kwargs: Any,
+) -> None:
     """Append output diffs to a file with time/diff columns (reference FileWriter +
-    DsvFormatter/JsonLinesFormatter semantics)."""
+    DsvFormatter/JsonLinesFormatter semantics).
+
+    ``sharded=True`` (r5): every worker writes its own key-shard's rows to
+    ``filename.part-<w>``; when the last shard closes, the parts merge-commit
+    into ``filename`` ordered by logical time (ties broken by worker index) and
+    the parts are removed. Under a multi-process cluster the parts remain on
+    disk per process (no cross-process close ordering) — consume them as a
+    part-file set, Spark-style."""
+    if sharded:
+        return _write_sharded(table, filename, format=format, **kwargs)
     cols = table.column_names()
+    line_fn, header = _row_formatter(format, cols)
     lock = threading.Lock()
     fh = open(filename, "w", newline="")
-    if format == "csv":
-        writer = _csv.writer(fh)
-        writer.writerow(cols + ["time", "diff"])
+    if header is not None:
+        fh.write(header)
 
-        def on_batch(batch: DeltaBatch, columns: list[str]) -> None:
-            with lock:
-                for key, diff, row in batch.rows():
-                    writer.writerow(list(row) + [batch.time, diff])
-                fh.flush()
-
-    elif format in ("json", "jsonlines"):
-
-        def on_batch(batch: DeltaBatch, columns: list[str]) -> None:
-            from pathway_tpu.internals.json import Json
-
-            with lock:
-                for key, diff, row in batch.rows():
-                    rec = {}
-                    for c, v in zip(columns, row):
-                        if isinstance(v, Json):
-                            v = v.value
-                        elif isinstance(v, (np.generic,)):
-                            v = v.item()
-                        elif isinstance(v, tuple):
-                            v = list(v)
-                        rec[c] = v
-                    rec["time"] = batch.time
-                    rec["diff"] = diff
-                    fh.write(_json.dumps(rec) + "\n")
-                fh.flush()
-
-    else:
-        raise ValueError(f"unknown format {format!r}")
+    def on_batch(batch: DeltaBatch, columns: list[str]) -> None:
+        with lock:
+            for _key, diff, row in batch.rows():
+                fh.write(line_fn(row, batch.time, diff))
+            fh.flush()
 
     def on_done() -> None:
         # on_end fires once per worker replica of the sink node; only the first
@@ -197,3 +189,122 @@ def write(table: Table, filename: str, *, format: str = "csv", **kwargs: Any) ->
         [table._node],
         name=f"fs_write:{filename}",
     )._register_as_output()
+
+
+def _row_formatter(format: str, cols: list[str]):  # noqa: A002
+    """line(row, time, diff) -> str, shared by the solo and sharded writers."""
+    if format == "csv":
+        import io as _io
+
+        def line(row, time, diff) -> str:
+            buf = _io.StringIO()
+            _csv.writer(buf).writerow(list(row) + [time, diff])
+            return buf.getvalue()
+
+        hbuf = _io.StringIO()
+        _csv.writer(hbuf).writerow(cols + ["time", "diff"])
+        return line, hbuf.getvalue()
+    if format in ("json", "jsonlines"):
+        from pathway_tpu.internals.json import Json
+
+        def line(row, time, diff) -> str:
+            rec = {}
+            for c, v in zip(cols, row):
+                if isinstance(v, Json):
+                    v = v.value
+                elif isinstance(v, np.generic):
+                    v = v.item()
+                elif isinstance(v, tuple):
+                    v = list(v)
+                rec[c] = v
+            rec["time"] = time
+            rec["diff"] = diff
+            return _json.dumps(rec) + "\n"
+
+        return line, None
+    raise ValueError(f"unknown format {format!r}")
+
+
+def _write_sharded(table: Table, filename: str, *, format: str, **kwargs: Any) -> None:  # noqa: A002
+    """Per-worker sink shards + ordered merge-commit (VERDICT r4 #2)."""
+    import heapq
+
+    cols = table.column_names()
+    line_fn, header = _row_formatter(format, cols)
+    lock = threading.Lock()
+    state: dict[str, Any] = {"parts": {}, "closed": set(), "n_workers": 1}
+
+    def _merge() -> None:
+        """All shards closed: merge parts into ``filename`` ordered by
+        (time, worker), then remove them. Parts are time-ordered internally
+        (ticks are monotonic), so a k-way stable merge suffices."""
+
+        def part_rows(w: int, path: str):
+            if format == "csv":
+                # csv.reader handles quoted embedded newlines (a raw line scan
+                # would split multi-physical-line records); re-serialize each
+                # record so the merged file stays one valid csv stream
+                import io as _io
+
+                with open(path, newline="") as fh:
+                    for i, rec in enumerate(_csv.reader(fh)):
+                        if i == 0 or not rec:
+                            continue  # per-part header
+                        buf = _io.StringIO()
+                        _csv.writer(buf).writerow(rec)
+                        yield (int(rec[len(cols)]), w, buf.getvalue())
+            else:
+                with open(path) as fh:
+                    for raw in fh:
+                        if not raw.strip():
+                            continue
+                        yield (int(_json.loads(raw)["time"]), w, raw)
+
+        parts = sorted(state["parts"].items())
+        with open(filename, "w", newline="") as out:
+            if header is not None:
+                out.write(header)
+            for _t, _w, raw in heapq.merge(
+                *(part_rows(w, p) for w, p in parts), key=lambda r: (r[0], r[1])
+            ):
+                out.write(raw)
+        for _w, p in parts:
+            os.remove(p)
+
+    def factory() -> Node:
+        from pathway_tpu.internals.logical import current_build
+
+        ctx = current_build()
+        w = ctx.worker_index if ctx is not None else 0
+        n = ctx.n_workers if ctx is not None else 1
+        part_path = f"{filename}.part-{w:04d}"
+        fh = open(part_path, "w", newline="")
+        if header is not None:
+            fh.write(header)
+        with lock:
+            state["parts"][w] = part_path
+            state["n_workers"] = max(state["n_workers"], n)
+
+        def on_batch(batch: DeltaBatch, columns: list[str]) -> None:
+            for _key, diff, row in batch.rows():
+                fh.write(line_fn(row, batch.time, diff))
+            fh.flush()
+
+        def on_done() -> None:
+            with lock:
+                if not fh.closed:
+                    fh.flush()
+                    fh.close()
+                state["closed"].add(w)
+                # thread plane: the last shard to close merge-commits; a
+                # cluster process only ever sees its local shards and leaves
+                # the part files for the consumer
+                if (
+                    len(state["closed"]) == state["n_workers"]
+                    and len(state["parts"]) == state["n_workers"]
+                ):
+                    _merge()
+
+        return ops.CallbackOutputNode(cols, on_batch, on_done, sharded=True)
+
+    LogicalNode(factory, [table._node], name=f"fs_write:{filename}")._register_as_output()

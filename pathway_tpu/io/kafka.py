@@ -147,6 +147,20 @@ def _conf_of(settings: dict) -> dict:
     return conf
 
 
+def _kafka_event_key(subject, topic: str, partition: int, offset: int, event_idx: int, values: tuple) -> int:
+    """Row key for one parsed event. pk schemas key by content (updates net in
+    place); otherwise the key derives from (topic, partition, offset,
+    event-within-message) — deterministic across worker counts and arrival
+    interleavings, unlike a per-subject sequential counter (which would
+    collide between workers' subjects), and unique per event even when one
+    message parses into several rows."""
+    if subject._pk_cols:
+        return subject._key_of(values)
+    from pathway_tpu.internals.keys import stable_hash_obj
+
+    return int(stable_hash_obj(("kafka", topic, partition, offset, event_idx)))
+
+
 def _read_real(
     settings: dict,
     topic: str,
@@ -160,14 +174,21 @@ def _read_real(
     """Consumer-driven read over the wire protocol client (reference
     ``KafkaReader``, ``src/connectors/data_storage.rs:712``): assigned
     partitions, per-partition offsets for the persistence seek contract,
-    static mode bounded by the watermark offsets captured at start."""
-    from pathway_tpu.io.python import ConnectorSubject, read as py_read
+    static mode bounded by the watermark offsets captured at start.
+    Partition-per-worker: each worker's subject assigns only partitions
+    ``p % n_workers == worker`` (``worker-architecture.md:36-47``)."""
+    from pathway_tpu.io.python import (
+        ConnectorSubject,
+        read_partitioned as py_read_partitioned,
+    )
 
     ck = _client_module(settings)
 
     class _RealKafkaSubject(ConnectorSubject):
-        def __init__(self) -> None:
+        def __init__(self, worker: int = 0, n_workers: int = 1) -> None:
             super().__init__()
+            self.worker = worker
+            self.n_workers = n_workers
             self._stop = False
             self._offsets: dict[int, int] = {}
             self.sync_lock = threading.Lock()
@@ -191,6 +212,9 @@ def _read_real(
                             f"{terr or 'unknown topic / no partitions'}"
                         )
                     parts = sorted(tmeta.partitions.keys())
+                parts = [p for p in parts if p % self.n_workers == self.worker]
+                if not parts:
+                    return  # more workers than partitions: this slice is empty
                 # fresh partitions start at OFFSET_BEGINNING (an absolute 0
                 # can be out of retention range and silently jump to the log
                 # end via auto.offset.reset)
@@ -229,14 +253,25 @@ def _read_real(
                             continue
                         raise RuntimeError(f"kafka consumer error: {err}")
                     with self.sync_lock:
-                        for ev in the_parser.parse(
-                            RawMessage(
-                                value=msg.value(),
-                                key=msg.key(),
-                                metadata={"partition": msg.partition()},
+                        assert self._node is not None
+                        self._node.push_many(
+                            (
+                                _kafka_event_key(
+                                    self, topic, msg.partition(), msg.offset(), j, ev.values
+                                ),
+                                ev.values,
+                                ev.diff,
                             )
-                        ):
-                            self._push(ev.values, diff=ev.diff)
+                            for j, ev in enumerate(
+                                the_parser.parse(
+                                    RawMessage(
+                                        value=msg.value(),
+                                        key=msg.key(),
+                                        metadata={"partition": msg.partition()},
+                                    )
+                                )
+                            )
+                        )
                         self._offsets[msg.partition()] = msg.offset() + 1
                     if ends is not None and all(
                         self._offsets.get(p, 0) >= ends[p] for p in parts
@@ -264,7 +299,11 @@ def _read_real(
         def on_stop(self) -> None:
             self._stop = True
 
-    return py_read(_RealKafkaSubject(), schema=schema, name=name or f"kafka:{topic}")
+    return py_read_partitioned(
+        lambda w, n: _RealKafkaSubject(w, n),
+        schema=schema,
+        name=name or f"kafka:{topic}",
+    )
 
 
 def read(
@@ -296,35 +335,60 @@ def read(
             broker, topic, schema, the_parser, mode, partitions, poll_interval, name
         )
 
-    from pathway_tpu.io.python import ConnectorSubject, read as py_read
+    from pathway_tpu.io.python import (
+        ConnectorSubject,
+        read_partitioned as py_read_partitioned,
+    )
 
     class _KafkaSubject(ConnectorSubject):
-        def __init__(self) -> None:
+        """One worker's slice of the topic. ``worker``/``n_workers`` pick the
+        partition subset (``p % n_workers == worker``); under a single-worker
+        runtime the subject owns every partition — the pre-r5 behavior."""
+
+        def __init__(self, worker: int = 0, n_workers: int = 1) -> None:
             super().__init__()
+            self.worker = worker
+            self.n_workers = n_workers
             self._stop = False
             self._offsets: dict[int, int] = {}
             # push-batch + offset-advance are atomic under this lock so a
             # persistence flush always sees offsets matching the pushed events
             self.sync_lock = threading.Lock()
 
+        def _my_parts(self) -> list[int]:
+            parts = partitions
+            if parts is None:
+                parts = list(range(max(1, broker.partitions(topic))))
+            return [p for p in parts if p % self.n_workers == self.worker]
+
         def run(self) -> None:
             while not self._stop:
-                parts = partitions
-                if parts is None:
-                    parts = list(range(max(1, broker.partitions(topic))))
                 progressed = False
-                for p in parts:
+                for p in self._my_parts():
                     off = self._offsets.get(p, 0)
                     msgs = broker.fetch(topic, p, off)
                     if not msgs:
                         continue
                     progressed = True
                     with self.sync_lock:
-                        for key, value in msgs:
-                            for ev in the_parser.parse(
-                                RawMessage(value=value, key=key, metadata={"partition": p})
+                        events = []
+                        for i, (key, value) in enumerate(msgs):
+                            for j, ev in enumerate(
+                                the_parser.parse(
+                                    RawMessage(value=value, key=key, metadata={"partition": p})
+                                )
                             ):
-                                self._push(ev.values, diff=ev.diff)
+                                events.append(
+                                    (
+                                        _kafka_event_key(
+                                            self, topic, p, off + i, j, ev.values
+                                        ),
+                                        ev.values,
+                                        ev.diff,
+                                    )
+                                )
+                        assert self._node is not None
+                        self._node.push_many(events)
                         self._offsets[p] = off + len(msgs)
                 if not progressed:
                     if mode == "static":
@@ -349,8 +413,10 @@ def read(
         def on_stop(self) -> None:
             self._stop = True
 
-    return py_read(
-        _KafkaSubject(), schema=schema, name=name or f"kafka:{topic}"
+    return py_read_partitioned(
+        lambda w, n: _KafkaSubject(w, n),
+        schema=schema,
+        name=name or f"kafka:{topic}",
     )
 
 

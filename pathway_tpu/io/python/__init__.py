@@ -268,3 +268,46 @@ def read(
 
 
 read_subject = read
+
+
+def read_partitioned(
+    make_subject,
+    *,
+    schema: schema_mod.SchemaMetaclass,
+    autocommit_duration_ms: int | None = None,
+    name: str | None = None,
+) -> Table:
+    """Partition-per-worker ingest (reference: Kafka read partition-per-worker,
+    ``worker-architecture.md:36-47``; r5 kills the worker-0 SOLO pin).
+
+    ``make_subject(worker_index, n_workers) -> ConnectorSubject`` builds one
+    subject per worker, each owning a disjoint slice of the source (e.g. Kafka
+    partitions ``p % n_workers == worker_index``). Every worker's node polls
+    locally (``local_source``); downstream co-location happens through the
+    normal key exchange. Under a single-worker runtime this degenerates to
+    ``read(make_subject(0, 1), ...)``.
+    """
+    from pathway_tpu.internals.logical import current_build
+
+    columns = schema.column_names()
+    np_dtypes = schema.np_dtypes()
+
+    def factory() -> Node:
+        ctx = current_build()
+        w = ctx.worker_index if ctx is not None else 0
+        n = ctx.n_workers if ctx is not None else 1
+        subject = make_subject(w, n)
+        subject._columns = columns
+        subject._pk_cols = schema.primary_key_columns()
+        node = ops.StreamInputNode(
+            columns, np_dtypes, upsert=subject._session_type == "upsert"
+        )
+        node.local_source = True  # poll on the owning worker, not worker 0
+        node.source_worker = w
+        subject._node = node
+        if ctx is not None and ctx.register is not None:
+            ctx.register(_SubjectDriver(subject))
+        return node
+
+    lnode = LogicalNode(factory, [], name=name or "python_connector_partitioned")
+    return Table(lnode, schema, Universe())

@@ -326,6 +326,11 @@ class ClusterRuntime:
         self._stop_requested = False
         self.current_time = 0
         self.local_workers: dict[int, _LocalWorker] = {}
+        # intra-process rows ride the local mesh; cross-process rows take the
+        # TCP links (the ICI/DCN split — see parallel/device_plane.py)
+        from pathway_tpu.parallel.device_plane import make_cluster_device_plane
+
+        self.device_plane = make_cluster_device_plane(self.n_workers, threads, pid)
         self.links = _PeerLinks(pid, processes, first_port, self._on_remote_block)
         if pid == 0:
             self.coord = _Coordinator(processes, first_port)
@@ -348,7 +353,12 @@ class ClusterRuntime:
         # build in reverse so global worker 0 (on process 0) builds LAST — its
         # nodes must own any shared holders (connector subjects, rest servers)
         for w in sorted(my_workers, reverse=True):
-            ctx = BuildContext(runtime=self if w == 0 else None)
+            ctx = BuildContext(
+                runtime=self if w == 0 else None,
+                worker_index=w,
+                n_workers=self.n_workers,
+                register=self.register_connector,
+            )
             for out in outputs:
                 ctx.resolve(out)
             if w == 0:
@@ -391,9 +401,17 @@ class ClusterRuntime:
                     for w_idx in range(self.n_workers):
                         self._deliver(w_idx, ci, port, batch)
                 else:
-                    shards = shard_of_keys(
-                        np.asarray(key_fn(batch), dtype=np.uint64), self.n_workers
-                    )
+                    route_keys = np.asarray(key_fn(batch), dtype=np.uint64)
+                    if (
+                        self.device_plane is not None
+                        and self.device_plane.should_stage(batch)
+                    ):
+                        self.device_plane.stage(
+                            ci, port, lw.index, route_keys, batch
+                        )
+                        routed = True
+                        continue
+                    shards = shard_of_keys(route_keys, self.n_workers)
                     for w_idx in np.unique(shards):
                         piece = batch.take(np.flatnonzero(shards == w_idx))
                         self._deliver(int(w_idx), ci, port, piece)
@@ -449,6 +467,10 @@ class ClusterRuntime:
         while True:
             self.links.check_error()
             did = self._sweep_all_local(time)
+            if self.device_plane is not None and self.device_plane.flush(
+                self._deliver, time
+            ):
+                did = True
             sent, received = self.links.counters()
             # pending is read AFTER the counters: a block that lands between
             # sweep and here is visible either as sent>recv or as pending
@@ -511,11 +533,19 @@ class ClusterRuntime:
 
     def run_tick(self, time: int) -> None:
         self.current_time = time
-        # sources poll on global worker 0 only
+        # non-partitioned sources poll on global worker 0 only; partitioned
+        # sources (local_source, r5) poll on every owning worker — including
+        # workers hosted by peer processes
         if 0 in self.local_workers:
             lw0 = self.local_workers[0]
             for node in lw0.graph.nodes:
                 self._route(lw0, node, run_annotated(node, node.poll, time))
+        for gi, lw in self.local_workers.items():
+            if gi == 0:
+                continue
+            for node in lw.graph.nodes:
+                if getattr(node, "local_source", False):
+                    self._route(lw, node, run_annotated(node, node.poll, time))
         self._round_until_quiescent(time, "sweep")
         while True:
             self._sync_watermarks()
@@ -554,9 +584,10 @@ class ClusterRuntime:
             # on ALL processes
             self.persistence.on_graph_built(getattr(self, "_ctx0", self._ctx_local))
             self.on_tick_done.append(self.persistence.on_tick_done)
-        if self.pid == 0:
-            for driver in self.connectors:
-                driver.start()
+        # every process starts ITS OWN connectors: process 0 owns the
+        # non-partitioned sources, peers own their workers' partition slices
+        for driver in self.connectors:
+            driver.start()
 
         period = (self.autocommit_duration_ms or 20) / 1000.0
         tick = 0
@@ -565,25 +596,29 @@ class ClusterRuntime:
                 t0 = _time.perf_counter()
                 self.run_tick(tick)
                 tick += 1
-                if self.pid == 0:
-                    from pathway_tpu.engine.runtime import check_connector_failures
+                from pathway_tpu.engine.runtime import check_connector_failures
 
-                    check_connector_failures(self.connectors)
-                # process 0 decides continuation (it owns the sources)
+                check_connector_failures(self.connectors)
+                # continuation: done when EVERY process's sources are
+                # exhausted (partitioned ingest spreads sources across
+                # processes) — or when ANY process requested a stop (streaming
+                # subjects never self-finish, so the stop flag must propagate
+                # to peers through the barrier, not wait on their is_finished)
+                local_done = all(d.is_finished() for d in self.connectors)
+                report = ("cont", local_done, self._stop_requested, 0)
                 if self.pid == 0:
-                    done = (
-                        self._stop_requested
-                        or not self.connectors
-                        or all(d.is_finished() for d in self.connectors)
-                    )
                     all_virtual = not self.connectors or all(
                         getattr(d, "virtual", False) for d in self.connectors
                     )
                     decision = self.coord.barrier(
-                        ("cont", done, 0, 0), lambda reports: {"done": done}
+                        report,
+                        lambda reports: {
+                            "done": any(r[2] for r in reports)
+                            or all(r[1] for r in reports)
+                        },
                     )
                 else:
-                    decision = self.client.barrier(("cont", False, 0, 0))
+                    decision = self.client.barrier(report)
                     all_virtual = True
                 if decision["done"]:
                     self.run_tick(tick)  # drain final events
@@ -593,15 +628,13 @@ class ClusterRuntime:
                     if elapsed < period:
                         _time.sleep(period - elapsed)
         finally:
-            if self.pid == 0:
-                for driver in self.connectors:
-                    driver.stop()
-        if self.pid == 0:
-            # re-check: a subject may error between the in-loop check and the
-            # is_finished break (see engine.runtime.Runtime.run)
-            from pathway_tpu.engine.runtime import check_connector_failures
+            for driver in self.connectors:
+                driver.stop()
+        # re-check: a subject may error between the in-loop check and the
+        # is_finished break (see engine.runtime.Runtime.run)
+        from pathway_tpu.engine.runtime import check_connector_failures
 
-            check_connector_failures(self.connectors)
+        check_connector_failures(self.connectors)
         self.close()
         return self
 

@@ -17,12 +17,17 @@ mask). Per-destination capacity is the full block capacity, so no row can
 overflow regardless of skew; the cost is an ``n_shards×`` staging buffer,
 the standard static-shape trade.
 
-Scope: the host TCP plane remains the default for the general engine (blocks
-carry strings/objects); this path serves the numeric fast lane — groupby /
-join key-partitioning of numeric columns — and is exercised multi-chip by
-``__graft_entry__.dryrun_multichip`` plus an 8-device CPU-mesh test
-(``tests/test_device_exchange.py``) that checks bit-parity with the host
-exchange + groupby.
+Scope (r5): this kernel is the PRODUCTION exchange for numeric blocks —
+``parallel/device_plane.py`` stages eligible batches from
+``ShardedRuntime``/``ClusterRuntime`` routing and flushes them through
+``exchange_by_key`` at sweep-round boundaries (``PATHWAY_DEVICE_EXCHANGE``
+= off/auto/on). Object columns stay on the host plane. Byte-identity with
+the host exchange is enforced by ``tests/test_device_plane.py`` (the full
+multiworker suite runs with the plane forced) and the multichip dryrun.
+Measured on the 8-device virtual CPU mesh the host plane is faster (its
+"exchange" is an intra-process pointer move; see BASELINE.md §exchange) —
+auto mode therefore keeps a row threshold, and the plane's win condition is
+real multi-chip ICI with HBM-resident blocks.
 """
 
 from __future__ import annotations
@@ -35,36 +40,44 @@ from pathway_tpu.internals.keys import SHARD_MASK
 
 
 @lru_cache(maxsize=64)
-def _jitted_exchange(mesh, axis: str, n_cols: int):
+def _jitted_exchange(mesh, axis: str, n_cols: int, with_dest: bool = False):
     """One compiled exchange per (mesh, axis, column-count): jit caches on
     function identity, so the per-tick call must reuse one closure or every
-    tick would pay a full retrace+compile."""
+    tick would pay a full retrace+compile. ``with_dest`` adds an explicit
+    per-row destination input (cluster plane: global shard mapped to a local
+    device index on host) instead of deriving it from the key bits."""
     import jax
     from jax.sharding import PartitionSpec as P
 
     n = mesh.shape[axis]
-    kern = _kernel(n, axis)
+    kern = _kernel(n, axis, with_dest)
+    in_specs = [P(None, axis), P(axis), P(axis), [P(axis)] * n_cols]
+    if with_dest:
+        in_specs.append(P(axis))
     return jax.jit(
         jax.shard_map(
             kern,
             mesh=mesh,
-            in_specs=(P(None, axis), P(axis), P(axis), [P(axis)] * n_cols),
+            in_specs=tuple(in_specs),
             out_specs=(P(None, axis), P(axis), P(axis), [P(axis)] * n_cols),
         )
     )
 
 
-def _kernel(n_shards: int, axis: str):
+def _kernel(n_shards: int, axis: str, with_dest: bool = False):
     import jax
     import jax.numpy as jnp
 
-    def local(keys, diffs, valid, cols):
+    def local(keys, diffs, valid, cols, dest=None):
         # keys arrive as uint32 pairs (hi, lo) — x64 stays off
         cap = keys.shape[1]
         hi, lo = keys[0], keys[1]
-        shard = ((lo & jnp.uint32(SHARD_MASK & 0xFFFFFFFF)) % jnp.uint32(n_shards)).astype(
-            jnp.int32
-        )
+        if with_dest:
+            shard = dest.astype(jnp.int32)
+        else:
+            shard = (
+                (lo & jnp.uint32(SHARD_MASK & 0xFFFFFFFF)) % jnp.uint32(n_shards)
+            ).astype(jnp.int32)
         shard = jnp.where(valid, shard, n_shards)  # invalid rows go nowhere
         # position of each row within its destination bucket
         onehot = (shard[None, :] == jnp.arange(n_shards)[:, None]).astype(jnp.int32)
@@ -101,7 +114,7 @@ def _kernel(n_shards: int, axis: str):
     return local
 
 
-def exchange_by_key(mesh, axis: str, keys, diffs, cols, valid):
+def exchange_by_key(mesh, axis: str, keys, diffs, cols, valid, dest=None):
     """Re-shard padded per-device blocks so every row lands on the device
     owning its key shard (host-plane parity: ``mesh.shard_of_keys``).
 
@@ -109,7 +122,14 @@ def exchange_by_key(mesh, axis: str, keys, diffs, cols, valid):
     ``keys`` uint32 (2, n_dev*cap) as (hi, lo) pairs, ``diffs`` int32,
     ``valid`` bool, ``cols`` list of numeric arrays. Returns the same
     structure with per-device row counts expanded to ``n_shards*cap`` (masked).
+
+    ``dest`` (int32, optional) routes each row to an explicit device index
+    instead of its key-shard — the cluster plane uses this to map GLOBAL
+    worker shards onto the process-local mesh.
     """
+    if dest is not None:
+        fn = _jitted_exchange(mesh, axis, len(cols), with_dest=True)
+        return fn(keys, diffs, valid, cols, dest)
     fn = _jitted_exchange(mesh, axis, len(cols))
     return fn(keys, diffs, valid, cols)
 

@@ -9,10 +9,16 @@ lockstep. This module is the block-engine version:
   logical outputs (node indices align across workers by construction).
 - At routing time, a consumer's :meth:`Node.exchange_key` decides placement:
   ``None`` → stay on the producing worker (stateless op); a key function →
-  split the block by ``shard_of_keys`` and deliver each piece to its owner;
-  ``SOLO`` → everything to worker 0 (serial operators: sources, sinks, sort's
-  global order, non-shardable external indexes). The temporal plane shards:
-  temporal/asof-now joins by join key, session windows by instance,
+  split the block by ``shard_of_keys`` and deliver each piece to its owner —
+  numeric blocks may instead ride the on-device all_to_all plane
+  (``parallel/device_plane.py``, ``PATHWAY_DEVICE_EXCHANGE``); ``SOLO`` →
+  everything to worker 0 (serial operators: non-partitioned sources,
+  unsharded sinks, sort's global order, non-shardable external indexes).
+  Partitioned sources (``local_source`` nodes, e.g. Kafka) poll on their OWN
+  worker with disjoint partition slices, and ``fs.write(sharded=True)`` sinks
+  write per-worker shards with an ordered merge-commit — the r5 SOLO-pin
+  kills (reference ``worker-architecture.md:36-47``). The temporal plane
+  shards: temporal/asof-now joins by join key, session windows by instance,
   buffer/forget/freeze row state by row key with one shared watermark cell
   per logical node (``internals/time_ops._SharedWatermark``).
 - Each tick runs sweep rounds: all workers sweep concurrently (threads), then
@@ -71,6 +77,11 @@ class ShardedRuntime:
         self._stop_requested = False
         self.current_time = 0
         self.on_tick_done: list[Any] = []
+        # on-device all_to_all exchange for numeric blocks (None = host-only;
+        # see parallel/device_plane.py and PATHWAY_DEVICE_EXCHANGE)
+        from pathway_tpu.parallel.device_plane import make_device_plane
+
+        self.device_plane = make_device_plane(n_workers)
 
     def register_connector(self, driver) -> None:
         self.connectors.append(driver)
@@ -85,7 +96,12 @@ class ShardedRuntime:
         # build must be the one whose sources actually receive events and poll
         self.workers = [None] * self.n_workers  # type: ignore[list-item]
         for w in list(range(1, self.n_workers)) + [0]:
-            ctx = BuildContext(runtime=self if w == 0 else None)
+            ctx = BuildContext(
+                runtime=self if w == 0 else None,
+                worker_index=w,
+                n_workers=self.n_workers,
+                register=self.register_connector,
+            )
             for out in outputs:
                 ctx.resolve(out)
             if w == 0:
@@ -126,9 +142,19 @@ class ShardedRuntime:
                         consumer.accept(port, batch)
                         routed = True
                         continue
-                    shards = shard_of_keys(
-                        np.asarray(key_fn(batch), dtype=np.uint64), self.n_workers
-                    )
+                    route_keys = np.asarray(key_fn(batch), dtype=np.uint64)
+                    if (
+                        self.device_plane is not None
+                        and self.device_plane.should_stage(batch)
+                    ):
+                        # numeric fast lane: the block rides the mesh at the
+                        # next flush instead of host-splitting here
+                        self.device_plane.stage(
+                            ci, port, worker.index, route_keys, batch
+                        )
+                        routed = True
+                        continue
+                    shards = shard_of_keys(route_keys, self.n_workers)
                     for w_idx in np.unique(shards):
                         piece = batch.take(np.flatnonzero(shards == w_idx))
                         target = self.workers[int(w_idx)]
@@ -181,14 +207,37 @@ class ShardedRuntime:
                 raise e
         return results
 
+    def _deliver(self, worker: int, ci: int, port: int, batch: DeltaBatch) -> None:
+        target = self.workers[worker]
+        with target.lock:
+            target.graph.nodes[ci].accept(port, batch)
+
+    def _sweep_round(self, time: int) -> bool:
+        """All workers sweep concurrently, then the device plane flushes its
+        staged blocks through one collective per group — the exchange lands
+        as new pending work, picked up by the next round."""
+        any_work = any(self._parallel(lambda w: self._sweep_worker(w, time)))
+        if self.device_plane is not None and self.device_plane.flush(
+            self._deliver, time
+        ):
+            any_work = True
+        return any_work
+
     def run_tick(self, time: int) -> None:
         self.current_time = time
-        # sources live on worker 0 only — peers' source copies never poll
-        # (polling them would duplicate every input row per worker)
+        # non-partitioned sources live on worker 0 only — peers' copies never
+        # poll (polling them would duplicate every input row per worker);
+        # partitioned sources (``local_source``) poll on their OWN worker,
+        # each subject owning a disjoint partition slice (r5: the SOLO-pin
+        # kill, reference worker-architecture.md:36-47)
         w0 = self.workers[0]
         for node in w0.graph.nodes:
             self._route(w0, node, run_annotated(node, node.poll, time))
-        while any(self._parallel(lambda w: self._sweep_worker(w, time))):
+        for w in self.workers[1:]:
+            for node in w.graph.nodes:
+                if getattr(node, "local_source", False):
+                    self._route(w, node, run_annotated(node, node.poll, time))
+        while self._sweep_round(time):
             pass
         progressed = True
         while progressed:
@@ -199,7 +248,7 @@ class ShardedRuntime:
                     if self._route(w, node, out):
                         progressed = True
             if progressed:
-                while any(self._parallel(lambda w: self._sweep_worker(w, time))):
+                while self._sweep_round(time):
                     pass
         for w in self.workers:
             for node in w.graph.nodes:

@@ -406,7 +406,14 @@ class Persistence:
                 offsets = dict(self.opsnap.manifest["input_offsets"])
                 self.opsnap.restore(self._worker_nodes)
         if self._is_cluster and self._pid != 0:
-            return  # sources poll only on process 0; peers hold no input logs
+            # non-partitioned sources poll only on process 0; partitioned
+            # sources (r5) DO live on peer processes — persist those locally
+            # (worker-scoped pids in the shared backend; seekable subjects
+            # recover by seeking, the at-least-once OSS tier)
+            self._add_partitioned_peer_inputs(offsets)
+            for p in self.inputs:
+                p.replay()
+            return
         # pid stability: a source keeps its snapshots across unrelated pipeline
         # edits — use the connector's name alone when unique among sources, and
         # only disambiguate same-named sources by their order among sources
@@ -419,6 +426,7 @@ class Persistence:
         for lnode, _ in sources:
             name_counts[lnode.name] = name_counts.get(lnode.name, 0) + 1
         seen: dict[str, int] = {}
+        pid_by_index: dict[int, str] = {}  # node_index -> pid (for peer copies)
         for lnode, node in sources:
             if name_counts[lnode.name] == 1:
                 pid = lnode.name
@@ -426,6 +434,7 @@ class Persistence:
                 i = seen.get(lnode.name, 0)
                 seen[lnode.name] = i + 1
                 pid = f"{lnode.name}-{i}"
+            pid_by_index[node.node_index] = pid
             self.inputs.append(
                 _PersistedInput(
                     pid,
@@ -436,8 +445,77 @@ class Persistence:
                     replay_skip=offsets.get(pid, 0),
                 )
             )
+        # partitioned sources also poll on workers 1..W-1 of a thread-sharded
+        # runtime (worker graphs align by node_index); each peer copy gets a
+        # worker-scoped pid so its partition offsets persist independently
+        workers = getattr(self.runtime, "workers", None) or []
+        peer_graphs = [(w.index, w.graph) for w in workers[1:]]
+        # cluster process 0 may host local workers beyond global worker 0
+        local_workers = getattr(self.runtime, "local_workers", None) or {}
+        peer_graphs += [(gi, lw.graph) for gi, lw in local_workers.items() if gi != 0]
+        for w_idx, graph in peer_graphs:
+            for node in graph.nodes:
+                if getattr(node, "local_source", False) and node.node_index in pid_by_index:
+                    pid = f"{pid_by_index[node.node_index]}@w{w_idx}"
+                    self.inputs.append(
+                        _PersistedInput(
+                            pid,
+                            node,
+                            self.backend,
+                            live_after_replay=getattr(
+                                self.config, "continue_after_replay", True
+                            ),
+                            subject=self._subject_of(node),
+                            replay_skip=offsets.get(pid, 0),
+                        )
+                    )
         for p in self.inputs:
             p.replay()
+
+    @staticmethod
+    def _dedup_source_pids(graph) -> dict[int, str]:
+        """node_index → persistent base pid, with the SAME dedup-suffix rule
+        process 0 applies to ctx.build_order (build order == node_index
+        order, and graphs are aligned across processes) — so a peer's
+        \"name-1@w3\" matches process 0's manifest bookkeeping."""
+        sources = [
+            n for n in graph.nodes if isinstance(n, ops.StreamInputNode)
+        ]
+        name_counts: dict[str, int] = {}
+        for n in sources:
+            name_counts[n.name] = name_counts.get(n.name, 0) + 1
+        seen: dict[str, int] = {}
+        out: dict[int, str] = {}
+        for n in sources:
+            if name_counts[n.name] == 1:
+                out[n.node_index] = n.name
+            else:
+                i = seen.get(n.name, 0)
+                seen[n.name] = i + 1
+                out[n.node_index] = f"{n.name}-{i}"
+        return out
+
+    def _add_partitioned_peer_inputs(self, offsets: dict) -> None:
+        """Cluster peers: wrap this process's partitioned source nodes
+        (local_source) in per-worker persisted inputs."""
+        local_workers = getattr(self.runtime, "local_workers", None) or {}
+        for gi, lw in local_workers.items():
+            base_pids = self._dedup_source_pids(lw.graph)
+            for node in lw.graph.nodes:
+                if getattr(node, "local_source", False):
+                    pid = f"{base_pids[node.node_index]}@w{gi}"
+                    self.inputs.append(
+                        _PersistedInput(
+                            pid,
+                            node,
+                            self.backend,
+                            live_after_replay=getattr(
+                                self.config, "continue_after_replay", True
+                            ),
+                            subject=self._subject_of(node),
+                            replay_skip=offsets.get(pid, 0),
+                        )
+                    )
 
     def _subject_of(self, node) -> Any:
         """Find the connector subject feeding ``node`` (for seekable sources)."""

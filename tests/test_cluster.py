@@ -119,6 +119,7 @@ def _run_cluster(script_path: str, out: str, *, processes: int, threads: int, ti
         outputs.append(stdout)
     for p, txt in zip(procs, outputs):
         assert p.returncode == 0, f"process exited {p.returncode}:\n{txt}"
+    return outputs
 
 
 @pytest.fixture

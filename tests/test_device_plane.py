@@ -1,0 +1,246 @@
+"""The on-device exchange plane as the PRODUCTION sharded exchange
+(VERDICT r4 #1): byte-identity of multi-worker runs with the plane forced on,
+fallback discipline for object columns, and auto-mode thresholding.
+
+Reference analogue: timely's channel fabric is the production exchange
+(``external/timely-dataflow/communication/src/networking.rs``); here numeric
+blocks ride ``lax.all_to_all`` over the 8-device virtual CPU mesh.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import pathway_tpu as pw
+from pathway_tpu.engine import operators as ops
+from pathway_tpu.internals.logical import LogicalNode
+from pathway_tpu.parallel.sharded import ShardedRuntime
+
+
+def _run_sharded(table, n_workers=4):
+    """Capture `table` under a ShardedRuntime, returning (keyed rows, runtime)."""
+    cols = table.column_names()
+    holder = {}
+
+    def factory():
+        node = ops.CaptureNode(cols)
+        holder["n"] = node
+        return node
+
+    lnode = LogicalNode(factory, [table._node], name="capture")
+    rt = ShardedRuntime(n_workers=n_workers, autocommit_duration_ms=5)
+    rt.run([lnode])
+    return dict(holder["n"].current), rt
+
+
+def _mk_numeric(n=3000, seed=3):
+    rng = np.random.default_rng(seed)
+    return pw.debug.table_from_rows(
+        pw.schema_from_types(k=int, v=int, t=int),
+        list(
+            zip(
+                rng.integers(0, 40, n).tolist(),
+                rng.integers(0, 1000, n).tolist(),
+                rng.integers(0, 100, n).tolist(),
+            )
+        ),
+    )
+
+
+@pytest.fixture
+def plane_on(monkeypatch):
+    monkeypatch.setenv("PATHWAY_DEVICE_EXCHANGE", "on")
+
+
+def test_groupby_rides_device_plane(plane_on):
+    def build():
+        t = _mk_numeric()
+        return t.groupby(t.k).reduce(
+            t.k, s=pw.reducers.sum(t.v), c=pw.reducers.count(), mx=pw.reducers.max(t.v)
+        )
+
+    truth, rt1 = _run_sharded(build(), n_workers=1)
+    got, rt4 = _run_sharded(build(), n_workers=4)
+    assert got == truth
+    assert rt4.device_plane is not None
+    assert rt4.device_plane.rows_exchanged > 0, "exchange never used the mesh"
+    assert rt4.device_plane.collectives > 0
+
+
+def test_join_rides_device_plane(plane_on):
+    def build():
+        t = _mk_numeric()
+        d = pw.debug.table_from_rows(
+            pw.schema_from_types(k=int, w=int), [(i, i * 3) for i in range(40)]
+        )
+        j = t.join(d, t.k == d.k).select(k=t.k, v=t.v + d.w)
+        return j.groupby(j.k).reduce(j.k, s=pw.reducers.sum(j.v))
+
+    truth, _ = _run_sharded(build(), n_workers=1)
+    got, rt4 = _run_sharded(build(), n_workers=4)
+    assert got == truth
+    assert rt4.device_plane.rows_exchanged > 0
+
+
+def test_windowby_rides_device_plane(plane_on):
+    def build():
+        t = _mk_numeric()
+        return t.windowby(
+            t.t, window=pw.temporal.tumbling(duration=10), instance=t.k
+        ).reduce(
+            k=pw.this._pw_instance,
+            start=pw.this._pw_window_start,
+            s=pw.reducers.sum(pw.this.v),
+        )
+
+    truth, _ = _run_sharded(build(), n_workers=1)
+    got, rt4 = _run_sharded(build(), n_workers=4)
+    assert got == truth
+    assert rt4.device_plane.rows_exchanged > 0
+
+
+def test_object_columns_fall_back_to_host(plane_on):
+    """String columns are host-plane territory; results stay correct and the
+    numeric-only stages may still ride the mesh."""
+
+    def build():
+        rng = np.random.default_rng(7)
+        t = pw.debug.table_from_rows(
+            pw.schema_from_types(k=int, name=str, v=int),
+            [
+                (int(k), f"n{k % 5}", int(v))
+                for k, v in zip(
+                    rng.integers(0, 30, 1500), rng.integers(0, 100, 1500)
+                )
+            ],
+        )
+        return t.groupby(t.name).reduce(
+            t.name, s=pw.reducers.sum(t.v), c=pw.reducers.count()
+        )
+
+    truth, _ = _run_sharded(build(), n_workers=1)
+    got, rt4 = _run_sharded(build(), n_workers=4)
+    assert got == truth
+
+
+def test_auto_mode_skips_small_blocks(monkeypatch):
+    monkeypatch.setenv("PATHWAY_DEVICE_EXCHANGE", "auto")
+    monkeypatch.setenv("PATHWAY_DEVICE_EXCHANGE_MIN_ROWS", "100000")
+
+    def build():
+        t = _mk_numeric(n=500)
+        return t.groupby(t.k).reduce(t.k, s=pw.reducers.sum(t.v))
+
+    got, rt4 = _run_sharded(build(), n_workers=4)
+    truth, _ = _run_sharded(build(), n_workers=1)
+    assert got == truth
+    assert rt4.device_plane is not None
+    assert rt4.device_plane.rows_exchanged == 0  # below threshold: host plane
+
+
+def test_off_mode_disables_plane(monkeypatch):
+    monkeypatch.setenv("PATHWAY_DEVICE_EXCHANGE", "off")
+
+    def build():
+        t = _mk_numeric(n=500)
+        return t.groupby(t.k).reduce(t.k, s=pw.reducers.sum(t.v))
+
+    got, rt4 = _run_sharded(build(), n_workers=4)
+    assert rt4.device_plane is None
+    truth, _ = _run_sharded(build(), n_workers=1)
+    assert got == truth
+
+
+def test_float_and_datetime_columns_bit_exact(plane_on):
+    """8-byte payloads (float64 bits, datetime64) survive the (hi,lo) u32
+    transport exactly."""
+    rng = np.random.default_rng(11)
+    n = 2000
+    base = np.datetime64("2024-01-01T00:00:00", "ns")
+    rows = [
+        (int(k), float(f), base + np.timedelta64(int(s), "s"))
+        for k, f, s in zip(
+            rng.integers(0, 25, n),
+            rng.standard_normal(n) * 1e10,
+            rng.integers(0, 10**6, n),
+        )
+    ]
+
+    def build():
+        t = pw.debug.table_from_rows(
+            pw.schema_from_types(k=int, f=float, ts=pw.DateTimeNaive), rows
+        )
+        return t.groupby(t.k).reduce(
+            t.k,
+            s=pw.reducers.sum(t.f),
+            mn=pw.reducers.min(t.f),
+            tmax=pw.reducers.max(t.ts),
+        )
+
+    truth, _ = _run_sharded(build(), n_workers=1)
+    got, rt4 = _run_sharded(build(), n_workers=4)
+    assert got == truth  # float bits + datetimes byte-identical
+    assert rt4.device_plane.rows_exchanged > 0
+
+
+# ------------------------------------------------------------------ the full
+# multiworker byte-identity suite re-run with the plane forced on: the device
+# exchange must be a drop-in for the host plane across every pipeline shape
+import test_multiworker as _tm  # noqa: E402
+
+_SUITE = [n for n in dir(_tm) if n.startswith("test_")]
+
+
+@pytest.mark.parametrize("case", _SUITE)
+def test_multiworker_suite_with_plane(case, plane_on):
+    getattr(_tm, case)()
+
+
+# ----------------------------------------------------------------- cluster
+def test_cluster_with_plane_byte_identical(tmp_path, monkeypatch):
+    """2 procs × 2 threads with the plane forced: byte-identical output to a
+    solo run, with intra-process rows verifiably riding the local mesh and
+    cross-process rows the TCP links (ClusterDevicePlane's ICI/DCN split)."""
+    import os
+    import textwrap
+
+    import test_cluster as tc
+
+    script = tmp_path / "pipeline.py"
+    script.write_text(
+        textwrap.dedent(
+            """
+            import sys
+            import numpy as np
+            import pathway_tpu as pw
+
+            out = sys.argv[1]
+            rng = np.random.default_rng(5)
+            n = 2000
+            t = pw.debug.table_from_rows(
+                pw.schema_from_types(k=int, v=int),
+                list(zip(rng.integers(0, 40, n).tolist(),
+                         rng.integers(0, 500, n).tolist())),
+            )
+            g = t.groupby(t.k).reduce(t.k, s=pw.reducers.sum(t.v),
+                                      c=pw.reducers.count())
+            pw.io.fs.write(g, out + ".csv", format="csv")
+            pw.run(monitoring_level="none")
+            rt = pw.internals.run.current_runtime()
+            plane = getattr(rt, "device_plane", None)
+            if plane is not None:
+                print("PLANE_ROWS", plane.rows_exchanged, flush=True)
+            """
+        )
+    )
+    solo = str(tmp_path / "solo")
+    monkeypatch.setenv("PATHWAY_DEVICE_EXCHANGE", "off")
+    tc._run_cluster(str(script), solo, processes=1, threads=1)
+    dist = str(tmp_path / "dist")
+    monkeypatch.setenv("PATHWAY_DEVICE_EXCHANGE", "on")
+    outputs = tc._run_cluster(str(script), dist, processes=2, threads=2)
+    assert tc._read(solo, ".csv") == tc._read(dist, ".csv")
+    if outputs is not None:  # helper returns captured stdout per process
+        assert any("PLANE_ROWS" in o and not o.strip().endswith("PLANE_ROWS 0")
+                   for o in outputs), f"plane never exchanged: {outputs}"

@@ -1,0 +1,237 @@
+"""Distributed sources + sharded sinks (VERDICT r4 #2: kill the worker-0 SOLO
+pin). Partition-per-worker Kafka ingest (reference
+``worker-architecture.md:36-47``), byte-identical output across worker counts,
+and per-worker sink shards with ordered merge-commit."""
+
+from __future__ import annotations
+
+import csv as _csv
+import os
+import textwrap
+
+import numpy as np
+import pytest
+
+import pathway_tpu as pw
+from pathway_tpu.internals.parse_graph import G
+from pathway_tpu.io.kafka import MockKafkaBroker
+from utils import rows_of
+
+N_MSGS = 400
+N_PARTS = 4
+
+
+def _filled_broker(path=None):
+    broker = MockKafkaBroker(path=path)
+    broker.create_topic("t", partitions=N_PARTS)
+    for i in range(N_MSGS):
+        broker.produce(
+            "t", f'{{"w": "w{i % 13}", "v": {i}}}', partition=i % N_PARTS
+        )
+    return broker
+
+
+def _wordcount(broker):
+    t = pw.io.kafka.read(
+        broker, "t", schema=pw.schema_from_types(w=str, v=int), mode="static"
+    )
+    return t.groupby(t.w).reduce(t.w, c=pw.reducers.count(), s=pw.reducers.sum(t.v))
+
+
+def _run_collect(table, n_workers):
+    got = {}
+    pw.io.subscribe(
+        table,
+        on_change=lambda key, row, time, is_addition: got.__setitem__(
+            key, (row, is_addition)
+        ),
+    )
+    pw.run(monitoring_level="none", n_workers=n_workers)
+    return {k: r for k, (r, add) in got.items() if add}
+
+
+def test_partitioned_ingest_byte_identical_and_spread():
+    broker = _filled_broker()
+    G.clear()
+    truth = _run_collect(_wordcount(broker), n_workers=1)
+    rt1 = pw.internals.run.current_runtime()
+    assert len(rt1.connectors) == 1  # single worker: one subject, all parts
+
+    G.clear()
+    got = _run_collect(_wordcount(broker), n_workers=4)
+    rt4 = pw.internals.run.current_runtime()
+    assert got == truth  # keyed rows byte-identical across worker counts
+
+    # ingest provably ran on >1 worker: one subject per worker, each having
+    # consumed only its own partition slice
+    subjects = [d.subject for d in rt4.connectors]
+    assert len(subjects) == 4
+    consumed = {s.worker: sorted(s._offsets) for s in subjects}
+    active = [w for w, parts in consumed.items() if parts]
+    assert len(active) == N_PARTS  # all four slices pulled their partition
+    for w, parts in consumed.items():
+        assert all(p % 4 == w for p in parts), f"worker {w} read {parts}"
+    # and the per-worker source nodes emitted rows from their own graphs
+    emitted = [
+        sum(
+            n.stats_rows_out
+            for n in rt4.workers[w].graph.nodes
+            if getattr(n, "local_source", False)
+        )
+        for w in range(4)
+    ]
+    assert sum(1 for e in emitted if e > 0) == N_PARTS, emitted
+
+
+def test_partitioned_ingest_more_workers_than_partitions():
+    broker = MockKafkaBroker()
+    broker.create_topic("t", partitions=2)
+    for i in range(100):
+        broker.produce("t", f'{{"w": "w{i % 5}", "v": {i}}}', partition=i % 2)
+    G.clear()
+    truth = _run_collect(_wordcount(broker), n_workers=1)
+    G.clear()
+    got = _run_collect(_wordcount(broker), n_workers=4)  # workers 2,3 idle
+    assert got == truth
+
+
+def test_partitioned_keys_deterministic_across_worker_counts():
+    """Offset-derived keys: the same message owns the same engine key no
+    matter how many workers ingest (required for byte-identity)."""
+    broker = _filled_broker()
+    G.clear()
+    t1 = pw.io.kafka.read(
+        broker, "t", schema=pw.schema_from_types(w=str, v=int), mode="static"
+    )
+    k1 = set(_run_collect(t1, n_workers=1))
+    G.clear()
+    t4 = pw.io.kafka.read(
+        broker, "t", schema=pw.schema_from_types(w=str, v=int), mode="static"
+    )
+    k4 = set(_run_collect(t4, n_workers=4))
+    assert k1 == k4
+
+
+# ------------------------------------------------------------- sharded sinks
+def test_sharded_sink_merge_commit(tmp_path):
+    broker = _filled_broker()
+    solo = str(tmp_path / "solo.csv")
+    G.clear()
+    pw.io.fs.write(_wordcount(broker), solo, format="csv")
+    pw.run(monitoring_level="none", n_workers=1)
+
+    out = str(tmp_path / "sharded.csv")
+    G.clear()
+    pw.io.fs.write(_wordcount(broker), out, format="csv", sharded=True)
+    pw.run(monitoring_level="none", n_workers=4)
+
+    assert os.path.exists(out)
+    assert not [p for p in os.listdir(tmp_path) if ".part-" in p], "parts left"
+
+    def net(path):
+        state: dict = {}
+        with open(path) as fh:
+            for rec in _csv.DictReader(fh):
+                k = rec["w"]
+                state[k] = state.get(k, 0) + int(rec["c"]) * int(rec["diff"])
+        return {k: v for k, v in state.items() if v}
+
+    assert net(out) == net(solo)
+    # merged rows are ordered by logical time (ordered commit)
+    with open(out) as fh:
+        times = [int(r["time"]) for r in _csv.DictReader(fh)]
+    assert times == sorted(times)
+
+
+def test_sharded_sink_jsonlines(tmp_path):
+    import json as _json
+
+    broker = _filled_broker()
+    out = str(tmp_path / "out.jsonl")
+    G.clear()
+    pw.io.fs.write(_wordcount(broker), out, format="jsonlines", sharded=True)
+    pw.run(monitoring_level="none", n_workers=3)
+    state: dict = {}
+    with open(out) as fh:
+        for line in fh:
+            rec = _json.loads(line)
+            state[rec["w"]] = state.get(rec["w"], 0) + rec["c"] * rec["diff"]
+    truth = {}
+    for i in range(N_MSGS):
+        truth[f"w{i % 13}"] = truth.get(f"w{i % 13}", 0)
+    for i in range(N_MSGS):
+        truth[f"w{i % 13}"] += 1
+    assert {k: v for k, v in state.items() if v} == truth
+
+
+# ------------------------------------------------------------------ cluster
+def test_cluster_partitioned_ingest(tmp_path):
+    """2 procs × 2 threads: partition slices ingest on BOTH processes (the
+    continuation barrier aggregates every process's source status), output
+    byte-identical to solo."""
+    import test_cluster as tc
+
+    broker_path = str(tmp_path / "broker")
+    _filled_broker(path=broker_path)
+
+    script = tmp_path / "pipeline.py"
+    script.write_text(
+        textwrap.dedent(
+            """
+            import os, sys
+            import pathway_tpu as pw
+            from pathway_tpu.io.kafka import MockKafkaBroker
+
+            out = sys.argv[1]
+            broker = MockKafkaBroker(path=os.environ["BROKER_PATH"])
+            t = pw.io.kafka.read(
+                broker, "t", schema=pw.schema_from_types(w=str, v=int),
+                mode="static",
+            )
+            g = t.groupby(t.w).reduce(
+                t.w, c=pw.reducers.count(), s=pw.reducers.sum(t.v)
+            )
+            pw.io.fs.write(g, out + ".csv", format="csv")
+            pw.run(monitoring_level="none")
+            rt = pw.internals.run.current_runtime()
+            drivers = getattr(rt, "connectors", [])
+            ingested = sum(
+                1 for d in drivers
+                if getattr(getattr(d, "subject", None), "_offsets", None)
+            )
+            print("INGESTED_SUBJECTS", ingested, flush=True)
+            """
+        )
+    )
+    os.environ["BROKER_PATH"] = broker_path
+    try:
+        solo = str(tmp_path / "solo")
+        tc._run_cluster(str(script), solo, processes=1, threads=1)
+        dist = str(tmp_path / "dist")
+        outputs = tc._run_cluster(str(script), dist, processes=2, threads=2)
+    finally:
+        os.environ.pop("BROKER_PATH", None)
+
+    # untimed streaming input: tick boundaries are wall-clock, so intermediate
+    # emissions (aggregate + later retraction) may differ by topology — the
+    # contract is NET equality of the diff streams (consistent with the
+    # reference's at-least-once OSS tier; timed-stream byte-identity is
+    # covered by test_cluster.py)
+    def net(path):
+        state: dict = {}
+        with open(path) as fh:
+            for rec in _csv.DictReader(fh):
+                c, s = state.get(rec["w"], (0, 0))
+                d = int(rec["diff"])
+                state[rec["w"]] = (c + int(rec["c"]) * d, s + int(rec["s"]) * d)
+        return {k: v for k, v in state.items() if v != (0, 0)}
+
+    assert net(solo + ".csv") == net(dist + ".csv")
+    # both processes ingested at least one partition slice
+    per_proc = [
+        int(line.split()[1])
+        for o in outputs
+        for line in o.splitlines()
+        if line.startswith("INGESTED_SUBJECTS")
+    ]
+    assert len(per_proc) == 2 and all(n >= 1 for n in per_proc), outputs

@@ -447,7 +447,15 @@ def test_operator_kill_restart_multiworker(tmp_path):
             try:
                 with open(manifest_path, "rb") as fh:
                     meta = pickle.loads(fh.read())
-                if meta["input_offsets"].get("words", 0) >= len(first):
+                # partitioned ingest (r5): each worker's slice logs under its
+                # own pid ("words", "words@w1", ...) — the covering condition
+                # is the SUM over slices
+                covered = sum(
+                    v
+                    for k, v in meta["input_offsets"].items()
+                    if k == "words" or k.startswith("words@w")
+                )
+                if covered >= len(first):
                     break
             except Exception:
                 pass  # mid-replace read; retry
