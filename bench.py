@@ -41,7 +41,7 @@ import numpy as np
 
 N_DOCS = 8192  # ~0.5 s per timed run: long enough to average out tunnel hiccups
 BATCH = 256  # torch-baseline batch (its CPU sweet spot)
-INGEST_BATCH = 512  # TPU ingest microbatch: fewer tunnel puts, same MXU shape
+INGEST_BATCH = 1024  # TPU ingest microbatch: fewer tunnel puts, higher MXU occupancy (r5 sweep)
 DOC_WORDS = 120  # tokenizes to ~121 ids -> bucket 128: genuinely fills L=128
 N_QUERIES = 64
 PER_ROW_BASELINE_ROWS = 24  # per-row torch CPU sample size (extrapolated)
@@ -154,11 +154,10 @@ def bench_tpu(docs: list[str]) -> tuple[float, dict]:
 
     # -- device-side compute rate: chained encodes, K vs 2K differencing ----
     # (one fetch amortized over the chain; the K/2K difference cancels the
-    # tunnel RTT and its jitter entirely)
+    # tunnel RTT and its jitter entirely). Batch sweep (r5): bigger batches
+    # amortize per-layer overheads and lift MXU occupancy — report the curve
+    # and headline the best point.
     from functools import partial as _partial
-
-    K = 16
-    ids_dev = jnp.asarray(ids_all[:INGEST_BATCH])
 
     @_partial(jax.jit, static_argnames=("length",))
     def enc_chain(params, ids0, length):
@@ -170,31 +169,57 @@ def bench_tpu(docs: list[str]) -> tuple[float, dict]:
         _, outs = jax.lax.scan(body, ids0, None, length=length)
         return outs
 
-    per_batch = _chain_rate(
-        lambda length: np.asarray(enc_chain(enc.params, ids_dev, length)), K
-    )
-    if per_batch is None:
+    sweep: dict = {}
+    best = (None, None)  # (docs_per_s, batch)
+    for B in (512, 1024, 2048):
+        ids_dev = jnp.asarray(ids_all[:B])
+        K = max(4, 8192 // B)
+        per_batch = _chain_rate(
+            lambda length: np.asarray(enc_chain(enc.params, ids_dev, length)), K
+        )
+        if per_batch is None:
+            sweep[str(B)] = None
+            continue
+        dev_rate = B / per_batch
+        sweep[str(B)] = round(dev_rate, 0)
+        if best[0] is None or dev_rate > best[0]:
+            best = (dev_rate, B)
+    extras["device_docs_per_s_by_batch"] = sweep
+    if best[0] is None:
         extras["device_docs_per_s"] = extras["device_tflops"] = None
-        extras["device_mfu_pct"] = None
+        extras["device_mfu_pct"] = extras["device_best_batch"] = None
     else:
-        dev_rate = INGEST_BATCH / per_batch
-        dev_tflops = dev_rate * flops_per_doc / 1e12
-        extras["device_docs_per_s"] = round(dev_rate, 0)
+        dev_tflops = best[0] * flops_per_doc / 1e12
+        extras["device_docs_per_s"] = round(best[0], 0)
+        extras["device_best_batch"] = best[1]
         extras["device_tflops"] = round(dev_tflops, 2)
         extras["device_mfu_pct"] = round(100 * dev_tflops / peak, 2) if peak else None
 
     # -- RAG query loop (Adaptive RAG hot path minus the external LLM) ------
+    from pathway_tpu.ops.reranker import JaxCrossEncoder, score as rerank_score
+
+    ce = JaxCrossEncoder(EncoderConfig(
+        vocab_size=32768, d_model=384, n_heads=6, n_layers=4, d_ff=1536, max_len=256
+    ), seed=1)
     q = "what is word42 about"
     qids, _ = enc.tokenizer([q])
     index.search(enc.encode_ids_device(jnp.asarray(qids)), k=10)  # warm [1, Lq]
+    # warm the rerank shape (10 pairs)
+    ce.score_pairs([(q, docs[i][:800]) for i in range(10)])
     lat = []
+    lat_rr = []
     for _ in range(30):
         t0 = time.perf_counter()
         emb = enc.encode_ids_device(jnp.asarray(qids))  # 1 async put
         hits = index.search(emb, k=10)[0]               # 1 packed fetch
         _context = "\n".join(docs[int(kk)][:200] for (kk, _s) in hits)
         lat.append((time.perf_counter() - t0) * 1000)
+        # full measured loop (BASELINE.json north star): embed→index→RERANK
+        scores = ce.score_pairs([(q, docs[int(kk)][:800]) for (kk, _s) in hits])
+        _best = hits[int(np.argmax(scores))]
+        lat_rr.append((time.perf_counter() - t0) * 1000)
     extras["rag_query_p50_ms"] = round(statistics.median(lat), 2)
+    extras["rag_query_rerank_p50_ms"] = round(statistics.median(lat_rr), 2)
 
     # device-side per-query latency: chained encode+search inside one jit
     index._flush()
@@ -215,6 +240,37 @@ def bench_tpu(docs: list[str]) -> tuple[float, dict]:
     # rises above tunnel jitter
     per_q = _chain_rate(lambda length: np.asarray(rag_chain(*args, qids_dev, length)), 256)
     extras["rag_query_device_ms"] = None if per_q is None else round(per_q * 1e3, 3)
+
+    # device-side FULL loop: embed → top-10 search → cross-encoder rerank, all
+    # inside one jit (the BASELINE.json metric is the embed+index+RERANK loop,
+    # question_answering.py:97-160 + rerankers.py:159 in the reference)
+    doc_toks_dev = jnp.asarray(ids_all.astype(np.int32))  # [N_DOCS, L] resident
+    ce_cfg = ce.cfg
+    ce_params = ce.params
+    Lq = int(qids.shape[1])
+
+    @_partial(jax.jit, static_argnames=("length",))
+    def rag_rerank_chain(params, vectors, norms, valid, bits, doc_toks, ids0, length):
+        def body(ids, _):
+            emb = encode(params, cfg, ids.astype(jnp.int32), ids != 0)
+            _s, si = _search_kernel(vectors, norms, valid, bits, emb, k=10, metric="cos")
+            dtoks = doc_toks[si[0]]                     # [10, L]
+            dtoks = dtoks.at[:, 0].set(2)               # doc CLS -> [SEP]
+            qrep = jnp.broadcast_to(ids0.astype(jnp.int32), (10, Lq))
+            pair = jnp.concatenate([qrep, dtoks], axis=1)
+            scores = rerank_score(ce_params, ce_cfg, pair, pair != 0)  # [10]
+            bump = (jnp.argmax(scores) % 2).astype(ids.dtype)
+            return ids ^ bump, scores[0]
+        _, outs = jax.lax.scan(body, ids0, None, length=length)
+        return outs
+
+    rr_args = (*args, doc_toks_dev)
+    per_rr = _chain_rate(
+        lambda length: np.asarray(rag_rerank_chain(*rr_args, qids_dev, length)), 64
+    )
+    extras["rag_query_rerank_device_ms"] = (
+        None if per_rr is None else round(per_rr * 1e3, 3)
+    )
     return rate, extras
 
 

@@ -1,7 +1,8 @@
 """Shared dependency-gate helper for connectors whose client libraries are not in
-this image (reference modules: minio, s3_csv, deltalake, iceberg, nats, pubsub,
-gdrive, airbyte, logstash, pyfilesystem, sharepoint). Each gated module keeps the
-reference's call signature and raises a clear NotImplementedError."""
+this image (remaining gated modules: airbyte, sharepoint — deltalake/iceberg are
+implemented against their open formats, gdrive against an injectable transport).
+Each gated module keeps the reference's call signature and raises a clear
+NotImplementedError."""
 
 from __future__ import annotations
 

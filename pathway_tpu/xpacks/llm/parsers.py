@@ -77,6 +77,67 @@ class PypdfParser(UDF):
         super().__init__(_fn=parse, return_type=list, **kwargs)
 
 
+class DocxParser(UDF):
+    """DOCX → text (r5): stdlib zip + WordprocessingML XML extraction
+    (``_docs.extract_docx_text``) — paragraphs, line breaks, tables. The
+    reference routes .docx through unstructured (``parsers.py:82``); this
+    parser is native to the image."""
+
+    def __init__(self, apply_text_cleanup: bool = True, **kwargs):
+        import re as _re
+
+        def parse(contents: Any) -> list:
+            from pathway_tpu.xpacks.llm._docs import extract_docx_text
+
+            data = contents if isinstance(contents, bytes) else bytes(contents)
+            text = extract_docx_text(data)
+            if apply_text_cleanup:
+                text = _re.sub(r"[ \t]+", " ", text)
+                text = _re.sub(r"\n{3,}", "\n\n", text).strip()
+            return [(text, {})]
+
+        super().__init__(_fn=parse, return_type=list, **kwargs)
+
+
+class HtmlParser(UDF):
+    """HTML → text (r5): ``html.parser``-based extraction — script/style
+    dropped, block structure preserved as line breaks, page title in the
+    chunk metadata."""
+
+    def __init__(self, **kwargs):
+        def parse(contents: Any) -> list:
+            from pathway_tpu.xpacks.llm._docs import extract_html_text
+
+            text, meta = extract_html_text(
+                contents if isinstance(contents, (bytes, str)) else bytes(contents)
+            )
+            return [(text, meta)]
+
+        super().__init__(_fn=parse, return_type=list, **kwargs)
+
+
+class MarkdownParser(UDF):
+    """Markdown → plain text (r5): headings/lists/emphasis/links stripped to
+    their text, fenced code kept as content."""
+
+    def __init__(self, **kwargs):
+        def parse(contents: Any) -> list:
+            from pathway_tpu.xpacks.llm._docs import extract_markdown_text
+
+            return [
+                (
+                    extract_markdown_text(
+                        contents
+                        if isinstance(contents, (bytes, str))
+                        else bytes(contents)
+                    ),
+                    {},
+                )
+            ]
+
+        super().__init__(_fn=parse, return_type=list, **kwargs)
+
+
 UnstructuredParser = _gated("UnstructuredParser", "unstructured")
 ParseUnstructured = UnstructuredParser
 DoclingParser = _gated("DoclingParser", "docling")

@@ -93,8 +93,12 @@ def test_gated_connectors_raise_clearly():
         pw.io.s3.read(
             "s3://b/x", format="plaintext", mode="static"
         )  # no boto3, no injected client
-    with pytest.raises(NotImplementedError, match="pyiceberg"):
-        pw.io.iceberg.write(None, "p")  # deltalake is real now; iceberg gates
+    with pytest.raises(NotImplementedError, match="REST catalog"):
+        # iceberg is real over a filesystem warehouse (r5); only the REST
+        # catalog transport gates
+        pw.io.iceberg.read(
+            "https://catalog:8181", ["ns"], "t", schema=pw.schema_from_types(v=int)
+        )
     with pytest.raises(NotImplementedError, match="psycopg2"):
         pw.io.postgres.write(None, {}, "t")
     with pytest.raises(NotImplementedError, match="confluent-kafka"):

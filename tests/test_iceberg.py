@@ -1,0 +1,248 @@
+"""Iceberg connector against the real table format (VERDICT r4 #5): Avro
+manifests + metadata JSON + parquet, round-trip / streaming / retractions —
+the deltalake playbook (reference ``src/connectors/data_lake/iceberg.rs:208``)."""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import pathway_tpu as pw
+from pathway_tpu.internals.parse_graph import G
+from utils import rows_of
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ----------------------------------------------------------------- avro unit
+def test_avro_container_round_trip(tmp_path):
+    from pathway_tpu.io import _avro
+
+    schema = {
+        "type": "record",
+        "name": "r",
+        "fields": [
+            {"name": "s", "type": "string"},
+            {"name": "n", "type": "long"},
+            {"name": "f", "type": "double"},
+            {"name": "ok", "type": "boolean"},
+            {"name": "opt", "type": ["null", "long"]},
+            {"name": "raw", "type": "bytes"},
+            {
+                "name": "nested",
+                "type": {
+                    "type": "record",
+                    "name": "inner",
+                    "fields": [{"name": "x", "type": "int"}],
+                },
+            },
+            {"name": "tags", "type": {"type": "array", "items": "string"}},
+            {"name": "props", "type": {"type": "map", "values": "long"}},
+        ],
+    }
+    records = [
+        {
+            "s": "héllo\nworld",
+            "n": -(2**40),
+            "f": 3.5,
+            "ok": True,
+            "opt": None,
+            "raw": b"\x00\xff",
+            "nested": {"x": 7},
+            "tags": ["a", "b"],
+            "props": {"k1": 1, "k2": -2},
+        },
+        {
+            "s": "",
+            "n": 0,
+            "f": -0.25,
+            "ok": False,
+            "opt": 42,
+            "raw": b"",
+            "nested": {"x": -1},
+            "tags": [],
+            "props": {},
+        },
+    ]
+    p = str(tmp_path / "t.avro")
+    _avro.write_container(p, schema, records)
+    got_schema, got = _avro.read_container(p)
+    assert got == records
+    assert got_schema == schema
+
+
+# -------------------------------------------------------------- write / read
+def test_iceberg_write_read_round_trip(tmp_path):
+    wh = str(tmp_path / "warehouse")
+    G.clear()
+    t = pw.debug.table_from_rows(
+        pw.schema_from_types(w=str, n=int), [("a", 1), ("b", 2), ("c", 3)]
+    )
+    pw.io.iceberg.write(t, wh, ["app"], "users")
+    pw.run(monitoring_level="none")
+
+    # protocol artifacts on disk
+    troot = os.path.join(wh, "app", "users")
+    mdir = os.path.join(troot, "metadata")
+    assert os.path.exists(os.path.join(mdir, "version-hint.text"))
+    version = int(open(os.path.join(mdir, "version-hint.text")).read())
+    meta = json.load(open(os.path.join(mdir, f"v{version}.metadata.json")))
+    assert meta["format-version"] == 2
+    assert meta["current-snapshot-id"] is not None
+    snap = next(
+        s for s in meta["snapshots"] if s["snapshot-id"] == meta["current-snapshot-id"]
+    )
+    from pathway_tpu.io import _avro
+
+    _s, manifests = _avro.read_container(os.path.join(troot, snap["manifest-list"]))
+    assert manifests and manifests[0]["manifest_path"].startswith("metadata/")
+    _s, entries = _avro.read_container(
+        os.path.join(troot, manifests[0]["manifest_path"])
+    )
+    assert entries[0]["data_file"]["file_format"] == "PARQUET"
+    assert entries[0]["data_file"]["record_count"] == 3
+
+    G.clear()
+    r = pw.io.iceberg.read(
+        wh, ["app"], "users", schema=pw.schema_from_types(w=str, n=int), mode="static"
+    )
+    assert sorted(rows_of(r)) == [("a", 1), ("b", 2), ("c", 3)]
+
+
+def test_iceberg_streaming_appends(tmp_path):
+    wh = str(tmp_path / "warehouse")
+    G.clear()
+    t1 = pw.debug.table_from_rows(pw.schema_from_types(w=str, n=int), [("a", 1)])
+    pw.io.iceberg.write(t1, wh, ["ns"], "t")
+    pw.run(monitoring_level="none")
+
+    G.clear()
+    r = pw.io.iceberg.read(wh, ["ns"], "t", schema=pw.schema_from_types(w=str, n=int))
+    got = []
+    pw.io.subscribe(
+        r, on_change=lambda key, row, time, is_addition: got.append((row["w"], row["n"]))
+    )
+
+    def appender():
+        time.sleep(0.3)
+        script = textwrap.dedent(
+            f"""
+            import pathway_tpu as pw
+            t = pw.debug.table_from_rows(
+                pw.schema_from_types(w=str, n=int), [("b", 2)]
+            )
+            pw.io.iceberg.write(t, {wh!r}, ["ns"], "t")
+            pw.run(monitoring_level="none")
+            """
+        )
+        subprocess.run(
+            [sys.executable, "-c", script],
+            env=dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS="cpu"),
+            check=True,
+            capture_output=True,
+        )
+        time.sleep(0.5)
+        rt = pw.internals.run.current_runtime()
+        if rt is not None:
+            rt.request_stop()
+
+    th = threading.Thread(target=appender, daemon=True)
+    th.start()
+    pw.run(monitoring_level="none")
+    th.join()
+    assert sorted(got) == [("a", 1), ("b", 2)]
+
+
+def test_iceberg_retractions_net_out(tmp_path):
+    wh = str(tmp_path / "warehouse")
+
+    class PkS(pw.Schema):
+        w: str = pw.column_definition(primary_key=True)
+        n: int
+
+    G.clear()
+    t = pw.debug.table_from_rows(
+        PkS,
+        [("a", 1, 0, 1), ("b", 2, 0, 1), ("a", 1, 1, -1), ("a", 5, 1, 1)],
+        is_stream=True,
+    )
+    pw.io.iceberg.write(t, wh, ["ns"], "t")
+    pw.run(monitoring_level="none")
+
+    G.clear()
+    r = pw.io.iceberg.read(wh, ["ns"], "t", schema=PkS, mode="static")
+    assert sorted(rows_of(r)) == [("a", 5), ("b", 2)]
+
+    # streaming replay nets the same way (content keys match retractions)
+    G.clear()
+    r2 = pw.io.iceberg.read(
+        wh, ["ns"], "t", schema=pw.schema_from_types(w=str, n=int), _bounded=True
+    )
+    cap = {}
+    pw.io.subscribe(
+        r2,
+        on_change=lambda key, row, time, is_addition: cap.__setitem__(
+            (row["w"], row["n"]), is_addition
+        ),
+    )
+    pw.run(monitoring_level="none")
+    live = sorted(k for k, add in cap.items() if add and k != ("a", 1))
+    assert live == [("a", 5), ("b", 2)]
+
+
+def test_iceberg_typed_round_trip(tmp_path):
+    wh = str(tmp_path / "warehouse")
+    G.clear()
+    ts = np.datetime64("2024-03-04T05:06:07", "ns")
+    t = pw.debug.table_from_rows(
+        pw.schema_from_types(w=str, ts=pw.DateTimeNaive, f=float, ok=bool),
+        [("a", ts, 2.5, True)],
+    )
+    pw.io.iceberg.write(t, wh, ["ns"], "typed")
+    pw.run(monitoring_level="none")
+    G.clear()
+    r = pw.io.iceberg.read(
+        wh,
+        ["ns"],
+        "typed",
+        schema=pw.schema_from_types(w=str, ts=pw.DateTimeNaive, f=float, ok=bool),
+        mode="static",
+    )
+    ((row, _),) = rows_of(r).items()
+    assert row[0] == "a" and isinstance(row[1], np.datetime64) and row[1] == ts
+    assert row[2] == 2.5 and row[3] is True
+
+
+def test_iceberg_rest_catalog_is_gated(tmp_path):
+    G.clear()
+    with pytest.raises(NotImplementedError, match="REST catalog"):
+        pw.io.iceberg.read(
+            "http://localhost:8181",
+            ["ns"],
+            "t",
+            schema=pw.schema_from_types(w=str),
+        )
+
+
+def test_iceberg_multi_snapshot_accumulates(tmp_path):
+    """Several writer runs append snapshots; the current snapshot's manifest
+    list covers ALL data files."""
+    wh = str(tmp_path / "warehouse")
+    for batch in ([("a", 1)], [("b", 2)], [("c", 3)]):
+        G.clear()
+        t = pw.debug.table_from_rows(pw.schema_from_types(w=str, n=int), batch)
+        pw.io.iceberg.write(t, wh, ["ns"], "acc")
+        pw.run(monitoring_level="none")
+    G.clear()
+    r = pw.io.iceberg.read(
+        wh, ["ns"], "acc", schema=pw.schema_from_types(w=str, n=int), mode="static"
+    )
+    assert sorted(rows_of(r)) == [("a", 1), ("b", 2), ("c", 3)]
