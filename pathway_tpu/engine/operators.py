@@ -1307,13 +1307,32 @@ class CallbackOutputNode(Node):
         on_batch: Callable,
         on_done: Callable | None = None,
         sharded: bool = False,
+        sink_state: Callable | None = None,
+        restore_sink: Callable | None = None,
     ):
         super().__init__(n_inputs=1)
         self.columns = columns
         self.on_batch = on_batch
         self.on_done = on_done
         self.sharded = sharded
+        # exactly-once hooks (r5, beating the reference's at-least-once OSS
+        # tier, README.md:96 / src/persistence/state.rs:291): a sink that can
+        # report a durable write position (sink_state) and rewind to it
+        # (restore_sink) participates in operator snapshots — restart
+        # truncates the output back to the snapshot cut, and the replayed
+        # suffix re-emits each output row exactly once
+        self.sink_state_fn = sink_state
+        self.restore_sink_fn = restore_sink
         self._tick_buffer: list[DeltaBatch] = []
+
+    def snapshot_state(self) -> dict | None:
+        if self.sink_state_fn is None:
+            return None
+        return {"__sink__": self.sink_state_fn()}
+
+    def restore_state(self, state: dict) -> None:
+        if self.restore_sink_fn is not None and "__sink__" in state:
+            self.restore_sink_fn(state["__sink__"])
 
     def process(self, inputs, time):
         # buffer within the tick; emission happens sorted at the frontier so the

@@ -163,32 +163,85 @@ def write(
     part-file set, Spark-style."""
     if sharded:
         return _write_sharded(table, filename, format=format, **kwargs)
+    parent = os.path.dirname(os.path.abspath(filename))
+    if not os.path.isdir(parent):
+        # fail at graph build like the eager-open era did, not mid-run
+        raise FileNotFoundError(f"fs.write: output directory does not exist: {parent}")
     cols = table.column_names()
     line_fn, header = _row_formatter(format, cols)
     lock = threading.Lock()
-    fh = open(filename, "w", newline="")
-    if header is not None:
-        fh.write(header)
+    # LAZY open (r5 exactly-once): opening "w" at graph build would truncate
+    # a previous run's output BEFORE the persistence layer can restore the
+    # snapshot write position; the handle opens on first write — or in
+    # restore_sink, which rewinds the existing file to the snapshot cut
+    state: dict[str, Any] = {"fh": None, "final_offset": None}
+
+    def _ensure_open():
+        if state["fh"] is None:
+            fh = open(filename, "w", newline="")
+            if header is not None:
+                fh.write(header)
+            state["fh"] = fh
+        return state["fh"]
 
     def on_batch(batch: DeltaBatch, columns: list[str]) -> None:
         with lock:
+            fh = _ensure_open()
             for _key, diff, row in batch.rows():
                 fh.write(line_fn(row, batch.time, diff))
             fh.flush()
 
     def on_done() -> None:
-        # on_end fires once per worker replica of the sink node; only the first
-        # (worker 0, the SOLO owner of the handle) actually closes the file
+        # on_end fires on the owning (worker-0) replica only
         with lock:
+            fh = _ensure_open()  # a zero-row run still yields the (header) file
             if not fh.closed:
                 fh.flush()
+                # the at-close snapshot runs AFTER on_end: remember the final
+                # position so sink_state doesn't report "nothing written" and
+                # doom the next restart to truncating the completed output
+                state["final_offset"] = fh.tell()
                 fh.close()
 
-    LogicalNode(
-        lambda: ops.CallbackOutputNode(cols, on_batch, on_done),
-        [table._node],
-        name=f"fs_write:{filename}",
-    )._register_as_output()
+    def sink_state() -> dict:
+        """Durable write position at a quiesced tick boundary — snapshotted
+        with the operator generation so restart rewinds to a consistent cut."""
+        with lock:
+            fh = state["fh"]
+            if fh is None or fh.closed:
+                return {"offset": state["final_offset"]}
+            fh.flush()
+            return {"offset": fh.tell()}
+
+    def restore_sink(s: dict) -> None:
+        with lock:
+            if state["fh"] is not None:
+                return  # already restored (other worker replicas share state)
+            off = s.get("offset")
+            if off is None or not os.path.exists(filename):
+                return  # nothing had been written at the snapshot: fresh file
+            fh = open(filename, "r+", newline="")
+            fh.truncate(off)
+            fh.seek(off)
+            state["fh"] = fh
+
+    def factory() -> Node:
+        from pathway_tpu.internals.logical import current_build
+
+        ctx = current_build()
+        # only global worker 0's replica owns the handle: a SOLO sink routes
+        # every row there, and peer replicas (other workers/processes) must
+        # not create-or-truncate the file from their own on_end
+        owner = ctx is None or ctx.worker_index == 0
+        return ops.CallbackOutputNode(
+            cols,
+            on_batch,
+            on_done if owner else None,
+            sink_state=sink_state if owner else None,
+            restore_sink=restore_sink if owner else None,
+        )
+
+    LogicalNode(factory, [table._node], name=f"fs_write:{filename}")._register_as_output()
 
 
 def _row_formatter(format: str, cols: list[str]):  # noqa: A002

@@ -15,7 +15,7 @@ from typing import Any
 
 import numpy as np
 
-from pathway_tpu.internals.udfs import UDF, AsyncExecutor
+from pathway_tpu.internals.udfs import UDF, async_executor
 from pathway_tpu.xpacks.llm._utils import require
 
 
@@ -85,23 +85,39 @@ class JaxEmbedder(SentenceTransformerEmbedder):
 
 
 class OpenAIEmbedder(BaseEmbedder):
-    """Remote OpenAI embeddings (reference ``embedders.py:88``); async UDF."""
+    """Remote OpenAI embeddings (reference ``embedders.py:88``); async UDF.
+    ``client=`` injects an OpenAI-shaped transport (r5: the wrapper's
+    request/parse/retry plumbing runs against canned responses in tests)."""
 
-    def __init__(self, model: str = "text-embedding-3-small", capacity: int | None = None, **openai_kwargs):
-        require("openai", "OpenAIEmbedder")
-        import openai
+    def __init__(
+        self,
+        model: str = "text-embedding-3-small",
+        capacity: int | None = None,
+        retry_strategy: Any = None,
+        cache_strategy: Any = None,
+        client: Any = None,
+        **openai_kwargs,
+    ):
+        if client is None:
+            require("openai", "OpenAIEmbedder")
+            import openai
 
+            client = openai.AsyncOpenAI(
+                **{k: v for k, v in openai_kwargs.items() if k in ("api_key", "base_url")}
+            )
         self.model = model
-        client = openai.AsyncOpenAI(
-            **{k: v for k, v in openai_kwargs.items() if k in ("api_key", "base_url")}
-        )
         extra = {k: v for k, v in openai_kwargs.items() if k not in ("api_key", "base_url")}
 
         async def embed(text: str) -> np.ndarray:
             r = await client.embeddings.create(input=[text or "."], model=model, **extra)
             return np.asarray(r.data[0].embedding, dtype=np.float32)
 
-        super().__init__(_fn=embed, return_type=np.ndarray, executor=AsyncExecutor(capacity=capacity))
+        super().__init__(
+            _fn=embed,
+            return_type=np.ndarray,
+            executor=async_executor(capacity=capacity, retry_strategy=retry_strategy),
+            cache_strategy=cache_strategy,
+        )
 
     def get_embedding_dimension(self, **kwargs) -> int:
         return {"text-embedding-3-small": 1536, "text-embedding-3-large": 3072,
@@ -109,24 +125,54 @@ class OpenAIEmbedder(BaseEmbedder):
 
 
 class LiteLLMEmbedder(BaseEmbedder):
-    def __init__(self, model: str, capacity: int | None = None, **kwargs):
-        require("litellm", "LiteLLMEmbedder")
-        import litellm
+    def __init__(
+        self,
+        model: str,
+        capacity: int | None = None,
+        retry_strategy: Any = None,
+        cache_strategy: Any = None,
+        aembedding: Any = None,
+        **kwargs,
+    ):
+        if aembedding is None:
+            require("litellm", "LiteLLMEmbedder")
+            import litellm
+
+            aembedding = litellm.aembedding
 
         async def embed(text: str) -> np.ndarray:
-            r = await litellm.aembedding(model=model, input=[text or "."], **kwargs)
+            r = await aembedding(model=model, input=[text or "."], **kwargs)
             return np.asarray(r.data[0]["embedding"], dtype=np.float32)
 
-        super().__init__(_fn=embed, return_type=np.ndarray, executor=AsyncExecutor(capacity=capacity))
+        super().__init__(
+            _fn=embed,
+            return_type=np.ndarray,
+            executor=async_executor(capacity=capacity, retry_strategy=retry_strategy),
+            cache_strategy=cache_strategy,
+        )
 
 
 class GeminiEmbedder(BaseEmbedder):
-    def __init__(self, model: str = "models/embedding-001", capacity: int | None = None, **kwargs):
-        require("google.generativeai", "GeminiEmbedder")
-        import google.generativeai as genai
+    def __init__(
+        self,
+        model: str = "models/embedding-001",
+        capacity: int | None = None,
+        retry_strategy: Any = None,
+        cache_strategy: Any = None,
+        client: Any = None,
+        **kwargs,
+    ):
+        if client is None:
+            require("google.generativeai", "GeminiEmbedder")
+            import google.generativeai as client  # noqa: F811 — module as client
 
         async def embed(text: str) -> np.ndarray:
-            r = genai.embed_content(model=model, content=text or ".", **kwargs)
+            r = client.embed_content(model=model, content=text or ".", **kwargs)
             return np.asarray(r["embedding"], dtype=np.float32)
 
-        super().__init__(_fn=embed, return_type=np.ndarray, executor=AsyncExecutor(capacity=capacity))
+        super().__init__(
+            _fn=embed,
+            return_type=np.ndarray,
+            executor=async_executor(capacity=capacity, retry_strategy=retry_strategy),
+            cache_strategy=cache_strategy,
+        )

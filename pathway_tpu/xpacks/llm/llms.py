@@ -1,18 +1,20 @@
 """Chat-model wrappers (reference ``xpacks/llm/llms.py:97-549``).
 
 Remote chats (OpenAI/LiteLLM/Cohere) are async UDFs gated on their client
-libraries. ``HFPipelineChat`` runs a local transformers pipeline (torch CPU in
-this image). All accept the reference's message-dict format and return strings.
+libraries — with INJECTABLE transports (the connector fake-client pattern,
+r5): pass ``client=`` (OpenAI/Cohere-shaped object) or ``acompletion=``
+(LiteLLM-shaped coroutine) and the wrapper's request/parse/retry/capacity
+plumbing runs without the real library (``tests/test_llm_wrappers.py``).
+``HFPipelineChat`` runs a local transformers pipeline (torch CPU in this
+image). All accept the reference's message-dict format and return strings.
 """
 
 from __future__ import annotations
 
 from typing import Any
 
-from pathway_tpu.internals.udfs import UDF, AsyncExecutor
+from pathway_tpu.internals.udfs import UDF, async_executor
 from pathway_tpu.xpacks.llm._utils import require
-
-
 
 
 class BaseChat(UDF):
@@ -28,13 +30,24 @@ def _as_messages(value: Any) -> list[dict]:
 
 
 class OpenAIChat(BaseChat):
-    def __init__(self, model: str = "gpt-4o-mini", capacity: int | None = None, **openai_kwargs):
-        require("openai", "OpenAIChat")
-        import openai
+    def __init__(
+        self,
+        model: str = "gpt-4o-mini",
+        capacity: int | None = None,
+        retry_strategy: Any = None,
+        cache_strategy: Any = None,
+        client: Any = None,
+        **openai_kwargs,
+    ):
+        # no `timeout` wrapper param: a timeout= kwarg flows through to the
+        # provider API (request-side bound), matching the pre-r5 behavior
+        if client is None:
+            require("openai", "OpenAIChat")
+            import openai
 
-        client = openai.AsyncOpenAI(
-            **{k: v for k, v in openai_kwargs.items() if k in ("api_key", "base_url")}
-        )
+            client = openai.AsyncOpenAI(
+                **{k: v for k, v in openai_kwargs.items() if k in ("api_key", "base_url")}
+            )
         extra = {k: v for k, v in openai_kwargs.items() if k not in ("api_key", "base_url")}
         self.model = model
 
@@ -44,29 +57,58 @@ class OpenAIChat(BaseChat):
             )
             return r.choices[0].message.content or ""
 
-        super().__init__(_fn=chat, return_type=str, executor=AsyncExecutor(capacity=capacity))
+        super().__init__(
+            _fn=chat,
+            return_type=str,
+            executor=async_executor(capacity=capacity, retry_strategy=retry_strategy),
+            cache_strategy=cache_strategy,
+        )
 
 
 class LiteLLMChat(BaseChat):
-    def __init__(self, model: str, capacity: int | None = None, **kwargs):
-        require("litellm", "LiteLLMChat")
-        import litellm
+    def __init__(
+        self,
+        model: str,
+        capacity: int | None = None,
+        retry_strategy: Any = None,
+        cache_strategy: Any = None,
+        acompletion: Any = None,
+        **kwargs,
+    ):
+        if acompletion is None:
+            require("litellm", "LiteLLMChat")
+            import litellm
 
+            acompletion = litellm.acompletion
         self.model = model
 
         async def chat(messages) -> str:
-            r = await litellm.acompletion(model=model, messages=_as_messages(messages), **kwargs)
+            r = await acompletion(model=model, messages=_as_messages(messages), **kwargs)
             return r.choices[0].message.content or ""
 
-        super().__init__(_fn=chat, return_type=str, executor=AsyncExecutor(capacity=capacity))
+        super().__init__(
+            _fn=chat,
+            return_type=str,
+            executor=async_executor(capacity=capacity, retry_strategy=retry_strategy),
+            cache_strategy=cache_strategy,
+        )
 
 
 class CohereChat(BaseChat):
-    def __init__(self, model: str = "command", capacity: int | None = None, **kwargs):
-        require("cohere", "CohereChat")
-        import cohere
+    def __init__(
+        self,
+        model: str = "command",
+        capacity: int | None = None,
+        retry_strategy: Any = None,
+        cache_strategy: Any = None,
+        client: Any = None,
+        **kwargs,
+    ):
+        if client is None:
+            require("cohere", "CohereChat")
+            import cohere
 
-        client = cohere.AsyncClient()
+            client = cohere.AsyncClient()
         self.model = model
 
         async def chat(messages) -> str:
@@ -74,7 +116,12 @@ class CohereChat(BaseChat):
             r = await client.chat(model=model, message=msgs[-1]["content"], **kwargs)
             return r.text
 
-        super().__init__(_fn=chat, return_type=str, executor=AsyncExecutor(capacity=capacity))
+        super().__init__(
+            _fn=chat,
+            return_type=str,
+            executor=async_executor(capacity=capacity, retry_strategy=retry_strategy),
+            cache_strategy=cache_strategy,
+        )
 
 
 class HFPipelineChat(BaseChat):

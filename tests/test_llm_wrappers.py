@@ -1,0 +1,244 @@
+"""LLM chat + embedder wrappers driven through fake transports (VERDICT r4
+#8): request/parse/retry/capacity/cache paths execute against canned-response
+clients — the connector fake-client pattern applied to the xpack (reference
+``xpacks/llm/llms.py:97-447``, ``embedders.py:88-250``)."""
+
+from __future__ import annotations
+
+import asyncio
+import threading
+import types
+
+import numpy as np
+import pytest
+
+import pathway_tpu as pw
+from pathway_tpu.internals.parse_graph import G
+from pathway_tpu.internals.udfs import (
+    FixedDelayRetryStrategy,
+    InMemoryCache,
+)
+from utils import rows_of
+
+
+# ------------------------------------------------------------ fake clients
+def _completion(text: str):
+    return types.SimpleNamespace(
+        choices=[types.SimpleNamespace(message=types.SimpleNamespace(content=text))]
+    )
+
+
+class FakeOpenAI:
+    """openai.AsyncOpenAI shape: .chat.completions.create / .embeddings.create;
+    records requests, optionally fails the first N calls (retry path)."""
+
+    def __init__(self, fail_first: int = 0, dim: int = 4):
+        self.requests: list = []
+        self.fail_remaining = fail_first
+        self.lock = threading.Lock()
+        self.concurrent = 0
+        self.max_concurrent = 0
+
+        outer = self
+
+        class _Completions:
+            async def create(self, *, model, messages, **kw):
+                with outer.lock:
+                    outer.concurrent += 1
+                    outer.max_concurrent = max(outer.max_concurrent, outer.concurrent)
+                try:
+                    await asyncio.sleep(0.01)
+                    outer.requests.append(("chat", model, messages))
+                    if outer.fail_remaining > 0:
+                        outer.fail_remaining -= 1
+                        raise RuntimeError("rate limited (canned)")
+                    return _completion(f"echo:{messages[-1]['content']}")
+                finally:
+                    with outer.lock:
+                        outer.concurrent -= 1
+
+        class _Embeddings:
+            async def create(self, *, input, model, **kw):  # noqa: A002
+                outer.requests.append(("embed", model, list(input)))
+                if outer.fail_remaining > 0:
+                    outer.fail_remaining -= 1
+                    raise RuntimeError("rate limited (canned)")
+                v = [float(len(input[0]))] * dim
+                return types.SimpleNamespace(
+                    data=[types.SimpleNamespace(embedding=v)]
+                )
+
+        self.chat = types.SimpleNamespace(completions=_Completions())
+        self.embeddings = _Embeddings()
+
+
+# ------------------------------------------------------------------- chats
+def _run_chat(chat_udf, questions):
+    G.clear()
+    t = pw.debug.table_from_rows(
+        pw.schema_from_types(q=str), [(q,) for q in questions]
+    )
+    out = t.select(q=t.q, a=chat_udf(t.q))
+    return {row[0]: row[1] for row in rows_of(out)}
+
+
+def test_openai_chat_request_parse():
+    from pathway_tpu.xpacks.llm.llms import OpenAIChat
+
+    fake = FakeOpenAI()
+    chat = OpenAIChat(model="gpt-test", client=fake)
+    got = _run_chat(chat, ["hello", "world"])
+    assert got == {"hello": "echo:hello", "world": "echo:world"}
+    kinds = {r[0] for r in fake.requests}
+    assert kinds == {"chat"}
+    # message-dict format forwarded
+    assert all(r[2][-1]["role"] == "user" for r in fake.requests)
+    assert all(r[1] == "gpt-test" for r in fake.requests)
+
+
+def test_openai_chat_retry_path():
+    from pathway_tpu.xpacks.llm.llms import OpenAIChat
+
+    fake = FakeOpenAI(fail_first=2)
+    chat = OpenAIChat(
+        model="gpt-test",
+        client=fake,
+        retry_strategy=FixedDelayRetryStrategy(max_retries=3, delay_ms=5),
+    )
+    got = _run_chat(chat, ["retry me"])
+    assert got == {"retry me": "echo:retry me"}
+    assert len(fake.requests) == 3  # two canned failures + the success
+
+
+def test_openai_chat_retries_exhausted_poison():
+    """Exhausted retries surface through the engine's error channel: the row
+    poisons to ERROR under capture's non-terminating policy, after exactly
+    max_retries+1 transport calls."""
+    from pathway_tpu.internals.errors import ERROR
+    from pathway_tpu.xpacks.llm.llms import OpenAIChat
+
+    fake = FakeOpenAI(fail_first=10)
+    chat = OpenAIChat(
+        model="gpt-test",
+        client=fake,
+        retry_strategy=FixedDelayRetryStrategy(max_retries=2, delay_ms=5),
+    )
+    G.clear()
+    t = pw.debug.table_from_rows(pw.schema_from_types(q=str), [("boom",)])
+    out = t.select(a=chat(t.q))
+    ((row,),) = [r for r in rows_of(out)]
+    assert row is ERROR
+    assert len(fake.requests) == 3  # initial + 2 retries, then gave up
+
+
+def test_openai_chat_capacity_bounds_concurrency():
+    from pathway_tpu.xpacks.llm.llms import OpenAIChat
+
+    fake = FakeOpenAI()
+    chat = OpenAIChat(model="gpt-test", client=fake, capacity=2)
+    got = _run_chat(chat, [f"q{i}" for i in range(12)])
+    assert len(got) == 12
+    assert fake.max_concurrent <= 2, fake.max_concurrent
+
+
+def test_openai_chat_cache_hits_skip_requests():
+    from pathway_tpu.xpacks.llm.llms import OpenAIChat
+
+    fake = FakeOpenAI()
+    chat = OpenAIChat(model="gpt-test", client=fake, cache_strategy=InMemoryCache())
+
+    # the cache dedups COMPLETED results (reference UdfCaching semantics):
+    # a later run re-asking the same question never reaches the transport
+    def ask_once():
+        G.clear()
+        t = pw.debug.table_from_rows(
+            pw.schema_from_types(q=str), [("same question",)]
+        )
+        out = t.select(q=t.q, a=chat(t.q))
+        return {row[0]: row[1] for row in rows_of(out)}
+
+    assert ask_once() == {"same question": "echo:same question"}
+    assert len(fake.requests) == 1
+    assert ask_once() == {"same question": "echo:same question"}
+    assert len(fake.requests) == 1, fake.requests  # second run: pure cache hit
+
+
+def test_litellm_chat_fake_transport():
+    from pathway_tpu.xpacks.llm.llms import LiteLLMChat
+
+    calls = []
+
+    async def acompletion(*, model, messages, **kw):
+        calls.append((model, messages))
+        return _completion(f"lite:{messages[-1]['content']}")
+
+    chat = LiteLLMChat(model="ollama/m", acompletion=acompletion)
+    got = _run_chat(chat, ["ping"])
+    assert got == {"ping": "lite:ping"}
+    assert calls and calls[0][0] == "ollama/m"
+
+
+def test_cohere_chat_fake_transport():
+    from pathway_tpu.xpacks.llm.llms import CohereChat
+
+    calls = []
+
+    class FakeCohere:
+        async def chat(self, *, model, message, **kw):
+            calls.append((model, message))
+            return types.SimpleNamespace(text=f"co:{message}")
+
+    chat = CohereChat(model="command-x", client=FakeCohere())
+    got = _run_chat(chat, ["hi"])
+    assert got == {"hi": "co:hi"}
+    assert calls == [("command-x", "hi")]
+
+
+# --------------------------------------------------------------- embedders
+def test_openai_embedder_request_parse_and_retry():
+    from pathway_tpu.xpacks.llm.embedders import OpenAIEmbedder
+
+    fake = FakeOpenAI(fail_first=1, dim=4)
+    emb = OpenAIEmbedder(
+        model="text-embedding-3-small",
+        client=fake,
+        retry_strategy=FixedDelayRetryStrategy(max_retries=2, delay_ms=5),
+    )
+    assert emb.dimension == 1536  # model-table dimension
+    G.clear()
+    t = pw.debug.table_from_rows(pw.schema_from_types(txt=str), [("abc",)])
+    out = t.select(v=emb(t.txt))
+    ((row,),) = pw.debug._capture(out).rows.values()
+    assert isinstance(row, np.ndarray) and row.dtype == np.float32
+    assert row.tolist() == [3.0] * 4  # canned embedding parsed
+    assert len(fake.requests) == 2  # one failure + one success (retried)
+
+
+def test_litellm_embedder_fake_transport():
+    from pathway_tpu.xpacks.llm.embedders import LiteLLMEmbedder
+
+    async def aembedding(*, model, input, **kw):  # noqa: A002
+        return types.SimpleNamespace(data=[{"embedding": [1.0, 2.0]}])
+
+    emb = LiteLLMEmbedder(model="m", aembedding=aembedding)
+    G.clear()
+    t = pw.debug.table_from_rows(pw.schema_from_types(txt=str), [("x",)])
+    out = t.select(v=emb(t.txt))
+    ((row,),) = pw.debug._capture(out).rows.values()
+    assert row.tolist() == [1.0, 2.0]
+
+
+def test_gemini_embedder_fake_transport():
+    from pathway_tpu.xpacks.llm.embedders import GeminiEmbedder
+
+    class FakeGenai:
+        @staticmethod
+        def embed_content(*, model, content, **kw):
+            return {"embedding": [0.5, 0.25]}
+
+    emb = GeminiEmbedder(client=FakeGenai())
+    G.clear()
+    t = pw.debug.table_from_rows(pw.schema_from_types(txt=str), [("x",)])
+    out = t.select(v=emb(t.txt))
+    ((row,),) = pw.debug._capture(out).rows.values()
+    assert row.tolist() == [0.5, 0.25]

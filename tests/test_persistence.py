@@ -489,13 +489,17 @@ def test_operator_kill_restart_multiworker(tmp_path):
     truth: dict = {}
     for w in first + second:
         truth[w] = truth.get(w, 0) + 1
-    s1, s2 = _net_counts(out1), _net_counts(out)
-    combined = dict(s1)
-    for w, c in s2.items():
-        combined[w] = combined.get(w, 0) + c
-    assert combined == truth, (combined, truth)
-    # O(state): aggregates untouched since the snapshot are not re-emitted
-    assert not any(w.startswith("only") for w in s2), s2
+    # exactly-once sinks (r5): the restart REWINDS the output file to the
+    # snapshot cut instead of truncating it, so the single final file IS the
+    # complete diff stream — no combining with the pre-kill copy
+    assert _net_counts(out) == truth, (_net_counts(out), truth)
+    # run 1's copy is a byte-prefix of the final file (the rewind kept it) …
+    with open(out1) as fh1, open(out) as fh2:
+        run1, final = fh1.read(), fh2.read()
+    assert final.startswith(run1)
+    # … and O(state): the restart tail re-emits NOTHING for aggregates
+    # untouched since the snapshot (the "only*" words never appear after it)
+    assert "only" not in final[len(run1):]
 
 
 def test_operator_snapshot_join_state(tmp_path):
@@ -529,3 +533,162 @@ def test_operator_snapshot_join_state(tmp_path):
 
     session([("a", 1)], {("a", 10)})
     session([("a", 1), ("b", 3)], {("b", 300)})
+
+
+_IDENTITY_PIPE = """
+import os
+import sys
+
+import pathway_tpu as pw
+from pathway_tpu.io.kafka import MockKafkaBroker
+
+broker = MockKafkaBroker(path=os.environ["BROKER_PATH"])
+expected = int(os.environ["EXPECTED_ROWS"])
+rows = pw.io.kafka.read(
+    broker, "rows", format="plaintext", mode="streaming", name="rows"
+)
+out = rows.select(data=rows.data)
+pw.io.fs.write(out, sys.argv[1], format="csv")
+
+total = out.reduce(c=pw.reducers.count())
+
+def on_total(key, row, time, is_addition):
+    if is_addition and row["c"] >= expected:
+        rt = pw.internals.run.current_runtime()
+        if rt is not None:
+            rt.request_stop()
+
+pw.io.subscribe(total, on_change=on_total)
+pw.run(
+    persistence_config=pw.persistence.Config(
+        backend=pw.persistence.Backend.filesystem(os.environ["PSTORE"]),
+        persistence_mode="operator_persisting",
+        snapshot_interval_ms=100,
+    )
+)
+"""
+
+
+def test_exactly_once_output_on_restart(tmp_path):
+    """VERDICT r4 #7 done-criterion: SIGKILL mid-stream + restart yields an
+    output file with ZERO duplicate lines — each unique input row appears
+    exactly once (the reference's OSS tier is at-least-once, README.md:96;
+    the sink-frontier snapshot + rewind beats it)."""
+    import csv as _csv2
+    import os
+    import pickle
+    import signal
+    import subprocess
+    import sys as _sys
+    import time as _time
+
+    script = tmp_path / "ident.py"
+    script.write_text(_IDENTITY_PIPE)
+    broker_path = str(tmp_path / "broker")
+    pstore = str(tmp_path / "pstore")
+    out = str(tmp_path / "out.csv")
+
+    first = [f"row-{i:05d}" for i in range(300)]
+    second = [f"row-{i:05d}" for i in range(300, 500)]
+
+    from pathway_tpu.io.kafka import MockKafkaBroker
+
+    broker = MockKafkaBroker(path=broker_path)
+    broker.create_topic("rows", partitions=2)
+    for i, w in enumerate(first):
+        broker.produce("rows", w, partition=i % 2)
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(
+        os.environ,
+        PYTHONPATH=repo,
+        JAX_PLATFORMS="cpu",
+        PATHWAY_THREADS="2",
+        BROKER_PATH=broker_path,
+        PSTORE=pstore,
+        EXPECTED_ROWS=str(10**9),  # run 1 never stops on its own
+    )
+    p = subprocess.Popen(
+        [_sys.executable, str(script), out],
+        env=env,
+        stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT,
+        text=True,
+    )
+    # kill as soon as ANY snapshot generation is committed (arbitrary cut:
+    # rows written after it will be rewound and re-emitted exactly once)
+    manifest_path = os.path.join(pstore, "operators", "manifest")
+    deadline = _time.time() + 90
+    while _time.time() < deadline:
+        if os.path.exists(manifest_path):
+            try:
+                with open(manifest_path, "rb") as fh:
+                    meta = pickle.loads(fh.read())
+                covered = sum(
+                    v
+                    for k, v in meta["input_offsets"].items()
+                    if k == "rows" or k.startswith("rows@w")
+                )
+                if covered >= 50:  # a mid-stream cut, not the full input
+                    break
+            except Exception:
+                pass
+        _time.sleep(0.03)
+    else:
+        p.kill()
+        raise AssertionError("no snapshot before deadline: " + (p.communicate()[0] or ""))
+    p.send_signal(signal.SIGKILL)
+    p.wait()
+
+    for i, w in enumerate(second):
+        broker.produce("rows", w, partition=i % 2)
+    env["EXPECTED_ROWS"] = str(len(first) + len(second))
+    p = subprocess.Popen(
+        [_sys.executable, str(script), out],
+        env=env,
+        stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT,
+        text=True,
+    )
+    stdout, _ = p.communicate(timeout=120)
+    assert p.returncode == 0, stdout
+
+    with open(out) as fh:
+        lines = [rec["data"] for rec in _csv2.DictReader(fh)]
+    assert sorted(lines) == sorted(first + second), (
+        f"{len(lines)} lines, {len(set(lines))} unique; "
+        f"dups={[w for w in set(lines) if lines.count(w) > 1][:5]}"
+    )
+
+
+def test_sink_survives_clean_stop_then_restart(tmp_path):
+    """Review r5: the at-close snapshot must record the sink's FINAL offset —
+    a clean stop followed by a restart with more data appends to the output
+    instead of truncating the completed file."""
+    import csv as _csv2
+
+    backend = pw.persistence.Backend.filesystem(str(tmp_path / "pstate"))
+    out = str(tmp_path / "out.csv")
+
+    def session(rows):
+        G.clear()
+        subj = ListSubject(rows)
+        t = pw.io.python.read(subj, schema=S, name="wordsource")
+        pw.io.fs.write(t, out, format="csv")
+        pw.run(
+            persistence_config=pw.persistence.Config(
+                backend=backend, persistence_mode="operator_persisting"
+            )
+        )
+
+    session([("a", 1), ("b", 2)])
+    with open(out) as fh:
+        first = [r["word"] for r in _csv2.DictReader(fh)]
+    assert sorted(first) == ["a", "b"]
+
+    # restart: deterministic source replays its longer list; only the suffix
+    # may be appended, the completed prefix must survive
+    session([("a", 1), ("b", 2), ("c", 3)])
+    with open(out) as fh:
+        words = [r["word"] for r in _csv2.DictReader(fh)]
+    assert sorted(words) == ["a", "b", "c"], words
