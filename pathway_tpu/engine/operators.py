@@ -1278,6 +1278,10 @@ class CaptureNode(Node):
         if bool((batch.diffs > 0).all()):  # all inserts: one C-speed update
             self.current.update(zip(keys, rows))
         else:
+            # per-row, in batch order: drain() may CONCATENATE several
+            # same-tick emissions without re-consolidating, so an insert from
+            # one emission can precede a retract from a later one — a
+            # two-pass pops-then-inserts apply would resurrect such keys
             for k, d, r in zip(keys, diffs, rows):
                 if d > 0:
                     self.current[k] = r

@@ -220,6 +220,19 @@ def write(
             off = s.get("offset")
             if off is None or not os.path.exists(filename):
                 return  # nothing had been written at the snapshot: fresh file
+            size = os.path.getsize(filename)
+            if off > size:
+                # the snapshot says `off` bytes were durably written but the
+                # file is shorter: it was externally truncated/replaced, and
+                # the consumed input prefix is already compacted — recovery
+                # cannot reconstruct it, so fail loudly instead of silently
+                # NUL-padding a corrupt output
+                raise RuntimeError(
+                    f"fs.write exactly-once restore: {filename!r} is {size} "
+                    f"bytes but the snapshot recorded {off}; the output file "
+                    "was modified outside the pipeline — remove it and the "
+                    "persistence storage to start fresh"
+                )
             fh = open(filename, "r+", newline="")
             fh.truncate(off)
             fh.seek(off)
