@@ -46,6 +46,11 @@ class EncoderConfig(NamedTuple):
     #: used when loading real HuggingFace checkpoints via ``from_pretrained``
     arch: str = "preln"
     ln_eps: float = 1e-6
+    #: allow the VMEM-resident pallas attention kernel (TPU, short L). MUST
+    #: be False under tensor-parallel meshes: pallas_call carries no GSPMD
+    #: sharding rule, so the Megatron column-split of wqkv can't partition
+    #: through it (JaxSentenceEncoder(mesh=...) clears this automatically)
+    pallas_attention: bool = True
 
 
 def init_params(cfg: EncoderConfig, key: jax.Array) -> dict:
@@ -124,12 +129,28 @@ def _layer_norm(x, g, b):
     return ((x32 - mu) * jax.lax.rsqrt(var + 1e-6) * g + b).astype(x.dtype)
 
 
+def _use_pallas_attention() -> bool:
+    # NOTE: read at TRACE time — the decision is baked into each compiled
+    # executable, so PATHWAY_PALLAS_ATTENTION must be set before the first
+    # encode of a given shape (flipping it later doesn't invalidate jit
+    # caches; restart the process to change paths)
+    import os
+
+    if os.environ.get("PATHWAY_PALLAS_ATTENTION", "auto").lower() in ("off", "0", "false"):
+        return False
+    try:
+        return jax.default_backend() == "tpu"
+    except Exception:
+        return False
+
+
 def _sdpa(q, k, v, mask, scale):
     """Fused scaled-dot-product attention on [B, L, H, hd] tensors (r5 MFU
     item): ``jax.nn.dot_product_attention`` hands XLA one fusible attention
-    expression (flash-style on TPU) instead of the materialized
-    scores→softmax→context chain; the manual chain remains as fallback for
-    stacks without the primitive. Key-padding mask is [B, L] bool."""
+    expression, with the manual chain as fallback for stacks without the
+    primitive. Key-padding mask is [B, L] bool. (The pallas short-seq kernel
+    enters one level up, in ``_attention``, on the FLAT layout — reshaping
+    to heads first costs more than the kernel saves, measured.)"""
     try:
         return jax.nn.dot_product_attention(
             q, k, v, mask=mask[:, None, None, :], scale=scale
@@ -149,15 +170,25 @@ def _sdpa(q, k, v, mask, scale):
         return ctx.transpose(0, 2, 1, 3)
 
 
-def _attention(x, wqkv, wo, mask, n_heads):
+def _attention(x, wqkv, wo, mask, n_heads, allow_pallas=True):
     """preln attention, bf16-native: MXU accumulation is f32 regardless of
     the requested OUTPUT dtype, so asking for f32 outputs only to cast them
     back (the r4 pattern) spends HBM bytes on f32 intermediates — dropping
-    the f32 epilogue measured 41→47% MFU on v5e (BASELINE.md §encoder-mfu)."""
+    the f32 epilogue measured +1pt MFU on v5e (BASELINE.md §encoder-mfu).
+    On TPU, short sequences run the VMEM-resident pallas kernel directly on
+    the FLAT [B, L, D] layout (heads = 64-wide column slices; scores never
+    touch HBM); ``allow_pallas=False`` (tensor-parallel meshes) keeps the
+    GSPMD-partitionable XLA path."""
     B, L, D = x.shape
     qkv = x @ wqkv.astype(x.dtype)
     q, k, v = jnp.split(qkv, 3, axis=-1)
     hd = D // n_heads
+    if allow_pallas and _use_pallas_attention():
+        from pathway_tpu.ops.attention_kernel import attention_short_flat
+
+        ctx = attention_short_flat(q, k, v, mask, n_heads, hd ** -0.5)
+        if ctx is not None:
+            return ctx @ wo.astype(x.dtype)
     ctx = _sdpa(
         q.reshape(B, L, n_heads, hd),
         k.reshape(B, L, n_heads, hd),
@@ -238,7 +269,10 @@ def encode(params: dict, cfg: EncoderConfig, token_ids: jax.Array, mask: jax.Arr
     x = x + params["pos"][:L][None, :, :].astype(cfg.dtype)
     for layer in params["layers"]:
         h = _layer_norm(x, layer["ln1"]["g"], layer["ln1"]["b"])
-        x = x + _attention(h, layer["wqkv"], layer["wo"], mask, cfg.n_heads)
+        x = x + _attention(
+            h, layer["wqkv"], layer["wo"], mask, cfg.n_heads,
+            allow_pallas=cfg.pallas_attention,
+        )
         h = _layer_norm(x, layer["ln2"]["g"], layer["ln2"]["b"])
         # bf16-native FF (f32 epilogue casts dropped — see _attention)
         h = jax.nn.gelu(h @ layer["w1"].astype(x.dtype))
@@ -492,6 +526,10 @@ class JaxSentenceEncoder:
             )
         self.tokenizer = tokenizer or HashTokenizer(self.cfg.vocab_size, self.cfg.max_len)
         if mesh is not None:
+            # tensor-parallel runs must keep the GSPMD-partitionable XLA
+            # attention: pallas_call has no sharding rule for the Megatron
+            # column-split of wqkv
+            self.cfg = self.cfg._replace(pallas_attention=False)
             self.params = jax.tree.map(
                 lambda p, s: jax.device_put(p, s),
                 self.params,
