@@ -265,8 +265,12 @@ def bench_tpu(docs: list[str]) -> tuple[float, dict]:
         return outs
 
     rr_args = (*args, doc_toks_dev)
+    # reps=9: this is the LAST chain metric of the run, when tunnel jitter is
+    # often worst — a null here drops the headline rerank-loop number
     per_rr = _chain_rate(
-        lambda length: np.asarray(rag_rerank_chain(*rr_args, qids_dev, length)), 64
+        lambda length: np.asarray(rag_rerank_chain(*rr_args, qids_dev, length)),
+        64,
+        reps=9,
     )
     extras["rag_query_rerank_device_ms"] = (
         None if per_rr is None else round(per_rr * 1e3, 3)
