@@ -559,6 +559,20 @@ def main() -> None:
         out.update(bench_knn_1m())
     except Exception as e:
         out["knn1m_error"] = repr(e)
+    try:
+        # r6 tentpole: cross-tick microbatching of device UDF streams
+        from benchmarks.streaming_bench import run as streaming_run
+
+        sb = streaming_run(2048, reps=3)
+        out["stream64_docs_per_s_microbatch"] = sb["stream64_docs_per_s_microbatch"]
+        out["stream64_docs_per_s_per_tick"] = sb["stream64_docs_per_s_per_tick"]
+        out["stream64_device_batch512_docs_per_s"] = sb["device_docs_per_s_batch512"]
+        out["stream64_microbatch_pct_of_batch512"] = sb["microbatch_pct_of_batch512"]
+        out["stream64_byte_identical"] = sb["byte_identical_outputs"]
+        out["stream64_chain_qps_microbatch"] = sb["chain_embed_knn_rerank_qps_microbatch"]
+        out["stream64_chain_qps_per_tick"] = sb["chain_embed_knn_rerank_qps_per_tick"]
+    except Exception as e:
+        out["streaming_bench_error"] = repr(e)[:200]
     print(json.dumps(out))
 
 

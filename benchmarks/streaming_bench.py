@@ -1,0 +1,184 @@
+"""Streaming microbatch benchmark: 64-row ticks through embed→KNN→rerank.
+
+The acceptance metric of the r6 tentpole (cross-tick device microbatching):
+a live stream delivering 64 rows per tick must sustain device-batch throughput,
+not per-tick-dispatch throughput. Three measurements:
+
+- ``device_docs_per_s_batch512``: the ceiling — direct jitted encode over the
+  corpus in 512-row batches (the r5 measured-best device batch).
+- ``stream64_docs_per_s_per_tick``: the engine pipeline with
+  ``PATHWAY_MICROBATCH=off`` — one encoder launch per 64-row tick (the
+  reference-style per-delta-block dispatch baseline).
+- ``stream64_docs_per_s_microbatch``: the same pipeline with the cross-tick
+  dispatcher on — rows accumulate across ticks and launch as full 512 buckets.
+
+Byte-identity: the captured embedding outputs of the off/auto runs must match
+exactly (the corpus is built with uniform token counts so the sequence bucket
+is composition-independent).
+
+A second leg drives the full embed→KNN→rerank chain (streamed queries against
+a doc index + cross-encoder scoring of the top hit) under both modes and
+checks identical results.
+
+Run: ``python benchmarks/streaming_bench.py [N_DOCS]``. Prints one JSON line.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+DOC_WORDS = 12  # uniform length -> one sequence bucket for any batch split
+TICK_ROWS = 64
+DEVICE_BATCH = 512
+
+
+def synth_docs(n: int) -> list[str]:
+    rng = np.random.default_rng(7)
+    vocab = [f"word{i}" for i in range(2000)]
+    return [" ".join(rng.choice(vocab, size=DOC_WORDS)) for _ in range(n)]
+
+
+def _embedder(preset: str = "tiny"):
+    from pathway_tpu.xpacks.llm.embedders import SentenceTransformerEmbedder
+
+    return SentenceTransformerEmbedder(preset, seed=0)
+
+
+def device_ceiling(docs: list[str], emb, reps: int = 3) -> float:
+    """Direct encode at the measured-best device batch — the throughput target.
+    Median of ``reps`` passes (host timing jitter dominates small corpora)."""
+    import statistics
+
+    enc = emb._encoder
+    enc.encode_texts(docs[:DEVICE_BATCH])  # warmup/compile
+    rates = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        for i in range(0, len(docs), DEVICE_BATCH):
+            enc.encode_texts(docs[i : i + DEVICE_BATCH])
+        rates.append(len(docs) / (time.perf_counter() - t0))
+    return statistics.median(rates)
+
+
+def _stream_embed_run(docs: list[str], mode: str, preset: str = "tiny"):
+    """Engine run: docs in 64-row ticks -> batched embedder UDF -> capture.
+    Returns (docs_per_s, {key: embedding bytes})."""
+    import pathway_tpu as pw
+    from pathway_tpu.debug import _capture
+    from pathway_tpu.internals.parse_graph import G
+
+    os.environ["PATHWAY_MICROBATCH"] = mode
+    G.clear()
+    emb = _embedder(preset)
+    emb._encoder.encode_texts(docs[:DEVICE_BATCH])  # compile outside the clock
+    emb._encoder.encode_texts(docs[: TICK_ROWS])
+    t = pw.debug.table_from_rows(
+        pw.schema_from_types(i=int, text=str),
+        [(i, d, i // TICK_ROWS, 1) for i, d in enumerate(docs)],
+        is_stream=True,
+    )
+    s = t.select(t.i, vec=emb(t.text))
+    t0 = time.perf_counter()
+    # latency budget 100 ms: the autocommit deadline bounds how long a row may
+    # wait in the cross-tick buffer (the trade-off documented in BASELINE.md)
+    cap = _capture(s, autocommit_duration_ms=100)
+    elapsed = time.perf_counter() - t0
+    out = {row[0]: np.asarray(row[1]).tobytes() for row in cap.rows.values()}
+    return len(docs) / elapsed, out
+
+
+def _chain_run(docs: list[str], queries: list[str], mode: str):
+    """embed→KNN→rerank: streamed queries over a doc index, cross-encoder
+    scores the top hit. Returns (queries_per_s, {qi: (top_doc, score)})."""
+    import pathway_tpu as pw
+    from pathway_tpu.debug import _capture
+    from pathway_tpu.internals.parse_graph import G
+    from pathway_tpu.stdlib.indexing import BruteForceKnnFactory
+    from pathway_tpu.xpacks.llm.rerankers import EncoderReranker
+
+    os.environ["PATHWAY_MICROBATCH"] = mode
+    G.clear()
+    emb = _embedder()
+    emb._encoder.encode_texts(docs[:DEVICE_BATCH])
+    doc_t = pw.debug.table_from_rows(
+        pw.schema_from_types(text=str), [(d,) for d in docs]
+    )
+    index = BruteForceKnnFactory(embedder=emb).build_index(doc_t.text, doc_t)
+    q_t = pw.debug.table_from_rows(
+        pw.schema_from_types(qi=int, q=str),
+        [(i, q, i // TICK_ROWS, 1) for i, q in enumerate(queries)],
+        is_stream=True,
+    )
+    picked = index.query_as_of_now(q_t.q, number_of_matches=1).select(
+        qi=pw.left.qi,
+        q=pw.left.q,
+        top=pw.apply(lambda ts: ts[0] if ts else "", pw.right.text),
+    )
+    rr = EncoderReranker(emb)
+    scored = picked.select(picked.qi, picked.top, score=rr(picked.top, picked.q))
+    t0 = time.perf_counter()
+    cap = _capture(scored)
+    elapsed = time.perf_counter() - t0
+    out = {row[0]: (row[1], round(float(row[2]), 6)) for row in cap.rows.values()}
+    return len(queries) / elapsed, out
+
+
+def run(n_docs: int = 4096, reps: int = 3) -> dict:
+    import statistics
+
+    prev = os.environ.get("PATHWAY_MICROBATCH")
+    try:
+        docs = synth_docs(n_docs)
+        emb = _embedder()
+        ceiling = device_ceiling(docs, emb, reps=reps)
+        # interleave the two modes so drift hits both equally; medians reported
+        per_tick_rates, micro_rates = [], []
+        per_tick_out = micro_out = None
+        for _ in range(reps):
+            dps, per_tick_out = _stream_embed_run(docs, "off")
+            per_tick_rates.append(dps)
+            dps, micro_out = _stream_embed_run(docs, "auto")
+            micro_rates.append(dps)
+        per_tick_dps = statistics.median(per_tick_rates)
+        micro_dps = statistics.median(micro_rates)
+        identical = per_tick_out == micro_out
+
+        q_n = min(512, n_docs)
+        chain_docs = docs[: min(512, n_docs)]
+        queries = [docs[i % len(chain_docs)] for i in range(q_n)]
+        chain_off_qps, chain_off = _chain_run(chain_docs, queries, "off")
+        chain_on_qps, chain_on = _chain_run(chain_docs, queries, "auto")
+        return {
+            "metric": "streaming 64-row ticks docs/s (embed; microbatch vs per-tick)",
+            "unit": "docs/s",
+            "n_docs": n_docs,
+            "tick_rows": TICK_ROWS,
+            "device_docs_per_s_batch512": round(ceiling, 1),
+            "stream64_docs_per_s_per_tick": round(per_tick_dps, 1),
+            "stream64_docs_per_s_microbatch": round(micro_dps, 1),
+            "value": round(micro_dps, 1),
+            "microbatch_pct_of_batch512": round(100.0 * micro_dps / ceiling, 1),
+            "per_tick_pct_of_batch512": round(100.0 * per_tick_dps / ceiling, 1),
+            "microbatch_speedup_vs_per_tick": round(micro_dps / per_tick_dps, 2),
+            "byte_identical_outputs": bool(identical),
+            "chain_embed_knn_rerank_qps_per_tick": round(chain_off_qps, 1),
+            "chain_embed_knn_rerank_qps_microbatch": round(chain_on_qps, 1),
+            "chain_outputs_identical": chain_off == chain_on,
+        }
+    finally:
+        if prev is None:
+            os.environ.pop("PATHWAY_MICROBATCH", None)
+        else:
+            os.environ["PATHWAY_MICROBATCH"] = prev
+
+
+if __name__ == "__main__":
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 4096
+    print(json.dumps(run(n)))

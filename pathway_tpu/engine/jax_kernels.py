@@ -43,6 +43,8 @@ from typing import Any
 
 import numpy as np
 
+from pathway_tpu import jax_compat
+
 _MIN_ROWS = 32_768  # below this, dispatch overhead dominates any kernel win
 
 
@@ -146,7 +148,7 @@ def grouped_sums(
     if kern is None:
         kern = _GROUPED_JIT[len(sum_cols)] = _jit_grouped(len(sum_cols))
     dev = _device()
-    with jax.enable_x64():
+    with jax_compat.enable_x64():
         args = (gkeys, diffs, tuple(sum_cols))
         if dev is not None:
             args = jax.device_put(args, dev)
@@ -316,7 +318,7 @@ def join_probe(sorted_jk: np.ndarray, q_jk: np.ndarray) -> tuple[np.ndarray, np.
     # auto mode adopts the probe on the CPU backend (the measured win);
     # explicit backends are honored as given
     dev = _device(force_cpu=flag() == "auto")
-    with jax.enable_x64():
+    with jax_compat.enable_x64():
         args = (sorted_jk, q_jk_padded)
         if dev is not None:
             args = jax.device_put(args, dev)

@@ -326,6 +326,451 @@ class FlattenNode(Node):
         ]
 
 
+# ------------------------------------------------------------------- microbatch UDF
+
+
+class MicrobatchUdfSpec:
+    """One ``is_batched`` UDF column of a microbatched select: the compiled
+    argument program plus the raw batch callable."""
+
+    __slots__ = (
+        "name", "args_program", "fn", "kw_names", "propagate_none",
+        "min_bucket", "deterministic",
+    )
+
+    def __init__(
+        self, name, args_program, fn, kw_names, propagate_none,
+        min_bucket=8, deterministic=False,
+    ):
+        self.name = name
+        #: batch -> (list of positional arg arrays, list of kwarg arrays)
+        self.args_program = args_program
+        self.fn = fn
+        self.kw_names = kw_names
+        self.propagate_none = propagate_none
+        self.min_bucket = min_bucket
+        self.deterministic = deterministic
+
+
+def _launch_udf_batch(spec: MicrobatchUdfSpec, items: list) -> list:
+    """Run one padded bucket through the UDF's batch fn. ``items`` are
+    ``(args_tuple, kwargs_tuple)`` rows; a failing batch retries row by row so
+    one bad input poisons only its own row (the inline BatchApply discipline,
+    ``expression_vm._eval_batch_apply``)."""
+    from pathway_tpu.internals.errors import report_error
+
+    args = [list(col) for col in zip(*(it[0] for it in items))]
+    kwargs = {
+        k: [it[1][j] for it in items] for j, k in enumerate(spec.kw_names)
+    }
+    try:
+        results = spec.fn(*args, **kwargs)
+        if len(results) != len(items):
+            raise ValueError(
+                f"batch UDF returned {len(results)} results for {len(items)} rows"
+            )
+        return list(results)
+    except Exception:
+        out = []
+        # pad rows are the SAME object as the last real item (repeat-last
+        # padding) — the identity cache computes each distinct row once, so
+        # the error path never re-runs the bucket's padding copies
+        cache: dict[int, Any] = {}
+        for it in items:
+            if id(it) in cache:
+                out.append(cache[id(it)])
+                continue
+            try:
+                r = spec.fn(
+                    *[[v] for v in it[0]],
+                    **{k: [it[1][j]] for j, k in enumerate(spec.kw_names)},
+                )
+                val = r[0]
+            except Exception as e:
+                val = report_error(
+                    f"apply {getattr(spec.fn, '__name__', spec.fn)!s}: {e!r}"
+                )
+            cache[id(it)] = val
+            out.append(val)
+        return out
+
+
+class MicrobatchApplyNode(Node):
+    """Cross-tick accumulate-then-launch select for ``is_batched`` device UDFs.
+
+    The wiring the framework's founding bet demands (PAPER.md, SURVEY §7.1.5):
+    instead of one jitted call per delta block — a streaming tick of 64 rows
+    dispatches a 64-row encoder call at a fraction of batch-512 device
+    throughput — rows are buffered **across ticks** per UDF, padded to
+    power-of-two buckets (``ops/microbatch.py``, compile-cache discipline) and
+    launched once per bucket. Full ``max_batch`` chunks launch as soon as they
+    accumulate; the tail flushes when the oldest buffered row ages past the
+    autocommit deadline, so added latency is bounded by
+    ``autocommit_duration_ms``. Static runs flush at their single tick's
+    frontier and behave exactly like the inline path.
+
+    ``mode="hold"`` (the measured default): buffered rows are invisible
+    downstream until their batch completes, then appear at the flush tick —
+    value-identical to per-block dispatch, timestamps may shift later.
+    ``mode="pending"``: rows appear immediately with ``PENDING`` in the UDF
+    columns and settle via a retract/insert correction on the completing tick —
+    the ``Value::Pending`` future discipline; consume through
+    ``Table.await_futures()``.
+
+    Retraction semantics: a retract of a still-buffered key cancels in-buffer
+    (the launch never sees it); a retract of a settled key replays the
+    remembered output row, so nondeterministic UDFs retract exactly what they
+    inserted. Output rows are remembered only while some UDF is NOT declared
+    deterministic (the reference caches non-deterministic UDF results for the
+    same reason); all-deterministic selects keep zero per-row state and
+    recompute retract rows, exactly like the inline path.
+    """
+
+    name = "microbatch_select"
+
+    snapshot_attrs = ("waiting", "emitted")
+
+    def exchange_key(self, port):
+        # device UDF rows spread across workers by key shard, same as an
+        # expensive RowwiseNode — each worker accumulates and launches its shard
+        return lambda batch: batch.keys
+
+    def __init__(
+        self,
+        out_columns: list[str],
+        pass_names: list[str],
+        pre_program: Callable[[DeltaBatch], dict[str, np.ndarray]],
+        udf_specs: list[MicrobatchUdfSpec],
+        np_dtypes: dict | None = None,
+        mode: str = "hold",
+        max_batch: int = 512,
+        flush_ms: float | None = None,
+        runtime: Any = None,
+    ):
+        super().__init__(n_inputs=1)
+        self.out_columns = out_columns
+        self.pass_names = pass_names
+        self.pre_program = pre_program
+        self.udf_specs = udf_specs
+        self.np_dtypes = np_dtypes or {}
+        self.mode = mode
+        self.max_batch = max_batch
+        self.flush_ms = flush_ms
+        self.runtime = runtime
+        # out column -> ("pass", i) | ("udf", j)
+        udf_pos = {s.name: j for j, s in enumerate(udf_specs)}
+        pass_pos = {n: i for i, n in enumerate(pass_names)}
+        self._slots = [
+            ("udf", udf_pos[n]) if n in udf_pos else ("pass", pass_pos[n])
+            for n in out_columns
+        ]
+        # key -> [diff, enqueue_wall_time, passthrough tuple, cells]; cells[j]
+        # is ("done", value) for instantly-decided rows (ERROR poisoning /
+        # propagate_none) or ("args", args_tuple, kwargs_tuple) awaiting launch
+        # (a later same-key insert overwrites: keyed last-write-wins, the
+        # discipline every keyed store in this engine follows)
+        self.waiting: dict[int, list] = {}
+        # key -> [count, row tuple] of settled rows live downstream. Retained
+        # ONLY while some UDF is not declared deterministic — retracts must
+        # then replay exactly what was inserted (the reference caches
+        # non-deterministic UDF results for the same reason). All-deterministic
+        # selects keep no state and recompute retract rows like the inline path.
+        self._remember = any(not s.deterministic for s in udf_specs)
+        self.emitted: dict[int, list] = {}
+
+    def restore_state(self, state: dict) -> None:
+        super().restore_state(state)
+        # snapshot-restored enqueue stamps came from another process's
+        # perf_counter epoch — reset so the deadline clock starts now
+        import time as _t
+
+        now = _t.perf_counter()
+        for entry in self.waiting.values():
+            entry[1] = now
+
+    # ------------------------------------------------------------- helpers
+
+    def _assemble(self, pass_vals: tuple, udf_vals: list) -> tuple:
+        return tuple(
+            pass_vals[i] if kind == "pass" else udf_vals[i]
+            for kind, i in self._slots
+        )
+
+    def _pending_row(self, entry: list) -> tuple:
+        from pathway_tpu.internals.errors import PENDING
+
+        cells = entry[3]
+        return self._assemble(
+            entry[2],
+            [c[1] if c[0] == "done" else PENDING for c in cells],
+        )
+
+    def _entry_rows(self, sub: DeltaBatch):
+        """(keys, diffs, pass tuples, cells) for an insert sub-batch."""
+        from pathway_tpu.internals.errors import ERROR
+
+        pre = self.pre_program(sub)
+        pass_lists = [column_to_list(np.asarray(pre[n])) for n in self.pass_names]
+        per_spec = [spec.args_program(sub) for spec in self.udf_specs]
+        n = len(sub)
+        rows_cells: list[list] = []
+        for r in range(n):
+            cells = []
+            for (arg_arrays, kw_arrays), spec in zip(per_spec, self.udf_specs):
+                vals = tuple(a[r] for a in arg_arrays)
+                kwvals = tuple(a[r] for a in kw_arrays)
+                if any(v is ERROR for v in vals) or any(v is ERROR for v in kwvals):
+                    cells.append(("done", ERROR))
+                elif spec.propagate_none and (
+                    any(v is None for v in vals) or any(v is None for v in kwvals)
+                ):
+                    cells.append(("done", None))
+                else:
+                    cells.append(("args", vals, kwvals))
+            rows_cells.append(cells)
+        pass_tuples = [tuple(pl[r] for pl in pass_lists) for r in range(n)]
+        return sub.keys.tolist(), sub.diffs.tolist(), pass_tuples, rows_cells
+
+    def _launch(self, all_cells: list[list]) -> list[list]:
+        """Run every awaiting cell through the padded dispatcher; returns one
+        value list per row, aligned with ``self.udf_specs``."""
+        from pathway_tpu.ops.microbatch import MicrobatchDispatcher
+
+        n = len(all_cells)
+        out = [[None] * len(self.udf_specs) for _ in range(n)]
+        for j, spec in enumerate(self.udf_specs):
+            need = [(i, all_cells[i][j]) for i in range(n) if all_cells[i][j][0] == "args"]
+            if need:
+                d = MicrobatchDispatcher(
+                    lambda items, s=spec: _launch_udf_batch(s, items),
+                    max_batch=self.max_batch,
+                    min_bucket=spec.min_bucket,
+                )
+                results = d.map([(cell[1], cell[2]) for _, cell in need])
+                for (i, _), rv in zip(need, results):
+                    out[i][j] = rv
+            for i in range(n):
+                cell = all_cells[i][j]
+                if cell[0] == "done":
+                    out[i][j] = cell[1]
+        return out
+
+    def _rows_for(self, sub: DeltaBatch) -> list[tuple]:
+        """Synchronous fallback: compute output rows for a sub-batch right now
+        (retractions of keys this node has no memory of — restored snapshots
+        excepted, only possible for rows that predate the node)."""
+        _keys, _diffs, pass_tuples, cells = self._entry_rows(sub)
+        udf_vals = self._launch(cells)
+        return [self._assemble(p, v) for p, v in zip(pass_tuples, udf_vals)]
+
+    # ------------------------------------------------------------- operator
+
+    def process(self, inputs, time):
+        batch = inputs[0]
+        if batch is None or not len(batch):
+            return []
+        batch = consolidate(batch)
+        if not len(batch):
+            return []
+        out: list[DeltaBatch] = []
+        dels = np.flatnonzero(batch.diffs < 0)
+        if len(dels):
+            out.extend(self._retract(batch, dels, time))
+        ins = np.flatnonzero(batch.diffs > 0)
+        if len(ins):
+            out.extend(self._enqueue(batch, ins, time))
+        if len(self.waiting) >= self.max_batch:
+            out.extend(self._flush(time, only_full=True))
+        return out
+
+    def _entry_sig(self, pass_vals: tuple, cells: list) -> tuple:
+        """Flat input signature of an entry — pass-through values + every UDF
+        arg — for matching a retract against a buffered insert by VALUE."""
+        flat = list(pass_vals)
+        for c in cells:
+            if c[0] == "done":
+                flat.append(c[1])
+            else:
+                flat.extend(c[1])
+                flat.extend(c[2])
+        return tuple(flat)
+
+    @staticmethod
+    def _sig_matches(a: tuple, b: tuple) -> bool:
+        """NaN-tolerant value equality: a retract row must match the buffered
+        copy of ITSELF even when an input value is NaN (NaN != NaN would
+        otherwise turn the cancel into a phantom retract + re-insert)."""
+        if len(a) != len(b):
+            return False
+        for x, y in zip(a, b):
+            if isinstance(x, np.ndarray) or isinstance(y, np.ndarray):
+                try:
+                    if not np.array_equal(x, y, equal_nan=True):
+                        return False
+                except TypeError:  # non-float dtypes reject equal_nan
+                    if not np.array_equal(x, y):
+                        return False
+            elif x != y:
+                if isinstance(x, float) and isinstance(y, float) \
+                        and np.isnan(x) and np.isnan(y):
+                    continue
+                return False
+        return True
+
+    def _retract(self, batch, idx, time):
+        out_keys: list[int] = []
+        out_diffs: list[int] = []
+        out_rows: list[tuple] = []
+        unknown: list[tuple[int, int]] = []  # (row index, residual diff)
+        # input signatures of every retract row whose key is buffered — one
+        # vectorized _entry_rows pass, not a 1-row program per retract
+        cand = [int(i) for i in idx if int(batch.keys[i]) in self.waiting]
+        sigs: dict[int, tuple] = {}
+        if cand:
+            _k, _d, pts, cls = self._entry_rows(
+                batch.take(np.asarray(cand, dtype=np.int64))
+            )
+            sigs = {i: self._entry_sig(p, c) for i, p, c in zip(cand, pts, cls)}
+        for i in idx:
+            i = int(i)
+            k = int(batch.keys[i])
+            d = int(batch.diffs[i])
+            w = self.waiting.get(k)
+            if w is not None:
+                # only a retract whose input VALUES match the buffered entry
+                # cancels in-buffer — a cross-tick upsert may retract the old
+                # settled version of the key after buffering the new one, and
+                # that retract must instead replay/recompute the settled row
+                if not self._sig_matches(sigs[i], self._entry_sig(w[2], w[3])):
+                    w = None
+            if w is not None:
+                # cancel at most the buffered count; any excess (consolidate
+                # may merge retracts of the buffered AND settled copies into
+                # one diff) falls through to the settled row below
+                cancel = max(d, -w[0])
+                if cancel:
+                    if self.mode == "pending":
+                        out_keys.append(k)
+                        out_diffs.append(cancel)
+                        out_rows.append(self._pending_row(w))
+                    w[0] += cancel
+                    if w[0] <= 0:
+                        del self.waiting[k]
+                    d -= cancel
+                if d == 0:
+                    continue
+            e = self.emitted.get(k)
+            if e is not None:
+                out_keys.append(k)
+                out_diffs.append(d)
+                out_rows.append(e[1])
+                e[0] += d
+                if e[0] <= 0:
+                    del self.emitted[k]
+                continue
+            unknown.append((i, d))
+        if unknown:
+            sub = batch.take(np.asarray([i for i, _ in unknown], dtype=np.int64))
+            for (i, dd), row in zip(unknown, self._rows_for(sub)):
+                out_keys.append(int(batch.keys[i]))
+                out_diffs.append(dd)
+                out_rows.append(row)
+        if not out_keys:
+            return []
+        return [
+            DeltaBatch.from_rows(
+                out_keys, out_rows, self.out_columns, time,
+                diffs=out_diffs, np_dtypes=self.np_dtypes,
+            )
+        ]
+
+    def _enqueue(self, batch, idx, time):
+        import time as _t
+
+        sub = batch.take(idx)
+        keys, diffs, pass_tuples, cells = self._entry_rows(sub)
+        now = _t.perf_counter()
+        entries = []
+        for r in range(len(keys)):
+            k = int(keys[r])
+            entry = [int(diffs[r]), now, pass_tuples[r], cells[r]]
+            prev = self.waiting.get(k)
+            if prev is not None:
+                entry[0] += prev[0]
+                entry[1] = prev[1]  # keep the oldest age for the deadline
+            self.waiting[k] = entry
+            entries.append(entry)
+        if self.mode != "pending":
+            return []
+        rows = [self._pending_row(e) for e in entries]
+        return [
+            DeltaBatch.from_rows(
+                [int(k) for k in keys], rows, self.out_columns, time,
+                diffs=[int(d) for d in diffs], np_dtypes=self.np_dtypes,
+            )
+        ]
+
+    def _flush(self, time, only_full: bool = False):
+        n = len(self.waiting)
+        consume = (n // self.max_batch) * self.max_batch if only_full else n
+        if consume == 0:
+            return []
+        keys = list(self.waiting.keys())[:consume]
+        entries = [self.waiting.pop(k) for k in keys]
+        udf_vals = self._launch([e[3] for e in entries])
+        out_keys: list[int] = []
+        out_diffs: list[int] = []
+        out_rows: list[tuple] = []
+        for k, entry, vals in zip(keys, entries, udf_vals):
+            diff = entry[0]
+            row = self._assemble(entry[2], vals)
+            if self.mode == "pending":
+                out_keys.append(k)
+                out_diffs.append(-diff)
+                out_rows.append(self._pending_row(entry))
+            out_keys.append(k)
+            out_diffs.append(diff)
+            out_rows.append(row)
+            if self._remember:
+                e = self.emitted.get(k)
+                if e is None:
+                    self.emitted[k] = [diff, row]
+                else:
+                    e[0] += diff
+                    e[1] = row
+        return [
+            DeltaBatch.from_rows(
+                out_keys, out_rows, self.out_columns, time,
+                diffs=out_diffs, np_dtypes=self.np_dtypes,
+            )
+        ]
+
+    def _should_flush(self, time) -> bool:
+        if time == END_OF_STREAM:
+            return True
+        rt = self.runtime
+        if rt is None or not getattr(rt, "streaming", False):
+            # static run: exactly one tick — flush at its frontier (emissions
+            # re-enter the same logical time, matching the inline path)
+            return True
+        conns = getattr(rt, "connectors", None)
+        if conns and all(d.is_finished() for d in conns):
+            # drain tick: sources exhausted, nothing more will accumulate
+            return True
+        first = next(iter(self.waiting.values()))
+        deadline = self.flush_ms
+        if deadline is None:
+            deadline = getattr(rt, "autocommit_duration_ms", 20) or 20
+        import time as _t
+
+        return (_t.perf_counter() - first[1]) * 1000.0 >= deadline
+
+    def on_frontier(self, time):
+        if not self.waiting or not self._should_flush(time):
+            return []
+        return self._flush(time)
+
+
 # ---------------------------------------------------------------------------- groupby
 
 

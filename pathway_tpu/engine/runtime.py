@@ -52,6 +52,11 @@ class Runtime:
         self.scheduler: Scheduler | None = None
         self.persistence: Any = None  # set by pathway_tpu.persistence.attach
         self._stop_requested = False
+        #: set once the graph is built: live-connector runs tick repeatedly, so
+        #: cross-tick accumulators (microbatch UDF buffers) may hold rows until
+        #: their autocommit deadline; static runs have exactly one tick and
+        #: must flush at its frontier
+        self.streaming = False
 
     def register_connector(self, driver: ConnectorDriver) -> None:
         self.connectors.append(driver)
@@ -61,6 +66,7 @@ class Runtime:
 
     def run(self, outputs: list[LogicalNode]) -> Scheduler:
         ctx = build_engine_graph(outputs, runtime=self)
+        self.streaming = bool(self.connectors)
         scheduler = Scheduler(ctx.graph)
         self.scheduler = scheduler
 

@@ -105,6 +105,44 @@ class PathwayConfig:
         return _env_int("PATHWAY_DEVICE_EXCHANGE_MIN_ROWS", 4096)
 
     @property
+    def microbatch(self) -> str:
+        """Cross-tick accumulate-then-launch dispatch for ``is_batched`` UDFs
+        (embedders/rerankers): ``off`` = one call per delta block (the r5
+        behavior), ``auto``/``on`` = buffer rows across ticks per (UDF, bucket)
+        and launch padded power-of-two batches, holding rows until their batch
+        completes (flushed on the autocommit deadline, so added latency is
+        bounded by ``autocommit_duration_ms``), ``pending`` = same batching but
+        rows appear immediately with ``PENDING`` in the UDF columns and settle
+        via a retract/insert correction on the completing tick (the
+        ``await_futures`` discipline, ``internals/table.py``). Measured default:
+        ``auto`` — BENCH_r06 streaming 64-row ticks reach batch-512 device
+        throughput instead of a fraction of it."""
+        mode = os.environ.get("PATHWAY_MICROBATCH", "auto").strip().lower()
+        if mode not in ("off", "auto", "on", "pending"):
+            raise ValueError(
+                f"PATHWAY_MICROBATCH must be off/auto/on/pending, got {mode!r}"
+            )
+        return mode
+
+    @property
+    def microbatch_max_batch(self) -> int:
+        """Device launch chunk for cross-tick microbatching; 512 is the measured
+        best batch on v5e (BENCH_r05 ``device_docs_per_s_by_batch``)."""
+        n = _env_int("PATHWAY_MICROBATCH_MAX_BATCH", 512)
+        if n < 1:
+            raise ValueError(
+                f"PATHWAY_MICROBATCH_MAX_BATCH must be >= 1, got {n}"
+            )
+        return n
+
+    @property
+    def microbatch_flush_ms(self) -> float | None:
+        """Override the buffer-age flush deadline (defaults to the runtime's
+        ``autocommit_duration_ms``)."""
+        raw = os.environ.get("PATHWAY_MICROBATCH_FLUSH_MS")
+        return None if raw in (None, "") else float(raw)
+
+    @property
     def monitoring_server(self) -> str | None:
         return os.environ.get("PATHWAY_MONITORING_SERVER")
 

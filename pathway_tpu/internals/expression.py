@@ -460,6 +460,10 @@ class ApplyExpression(ColumnExpression):
             propagate_none=self.propagate_none,
             deterministic=self.deterministic,
         )
+        if hasattr(self, "udf"):
+            # rebinding (pw.this / join / groupby arg resolution) must not
+            # strip the UDF backref — the microbatch planner reads its knobs
+            new.udf = self.udf
         return new
 
     def _dtype(self, env: TypeEnv) -> dt.DType:

@@ -60,11 +60,17 @@ class BuildContext:
         worker_index: int = 0,
         n_workers: int = 1,
         register: Any = None,
+        shared_runtime: Any = None,
     ):
         self.graph = EngineGraph()
         self.built: dict[int, Node] = {}
         self.build_order: list[tuple[LogicalNode, Node]] = []
         self.runtime = runtime
+        #: the runtime every worker's build may INSPECT (tick cadence /
+        #: streaming-vs-static, e.g. microbatch flush deadlines) — distinct
+        #: from ``runtime``, which is set only on the primary build because
+        #: runtime_hooks (connector registration) must fire once
+        self.shared_runtime = shared_runtime if shared_runtime is not None else runtime
         #: which worker this graph copy belongs to / total worker count —
         #: partitioned sources read disjoint partition sets per worker
         #: (reference: partition-per-worker Kafka, worker-architecture.md:36-47)

@@ -135,6 +135,11 @@ class Table:
         tables = _referenced_tables(exprs.values())
         tables.discard(self)
         if not tables:
+            schema = self._infer_schema(exprs)
+            micro = _microbatch_factory(exprs, self, schema)
+            if micro is not None:
+                node = LogicalNode(micro, [self._node], name="select_microbatch")
+                return Table(node, schema, self._universe)
             program = _compile_program(exprs, self)
             expensive = any(_has_apply(e) for e in exprs.values())
             node = LogicalNode(
@@ -142,7 +147,7 @@ class Table:
                 [self._node],
                 name="select",
             )
-            return Table(node, self._infer_schema(exprs), self._universe)
+            return Table(node, schema, self._universe)
         return _multi_table_select(self, list(tables), exprs, self._infer_schema(exprs))
 
     def with_columns(self, *args: Any, **kwargs: Any) -> "Table":
@@ -676,6 +681,100 @@ def _has_apply(e) -> bool:
     if isinstance(e, expr_mod.ApplyExpression):
         return True
     return any(_has_apply(a) for a in e._args())
+
+
+def _microbatch_factory(
+    exprs: dict[str, ColumnExpression], source: Table, schema: schema_mod.SchemaMetaclass
+) -> Callable | None:
+    """Engine-node factory for a select whose top-level columns include
+    ``is_batched`` UDF calls (``BatchApplyExpression``) — the device UDF path.
+
+    Routed through :class:`~pathway_tpu.engine.operators.MicrobatchApplyNode`
+    so rows accumulate ACROSS ticks per UDF and launch as padded power-of-two
+    batches (``PATHWAY_MICROBATCH``; ``off`` restores one call per delta
+    block). Returns ``None`` — keep the inline RowwiseNode path — when the
+    flag is off or no column is a top-level batch apply.
+    """
+    from pathway_tpu.internals.config import get_pathway_config
+
+    cfg = get_pathway_config()
+    mode = cfg.microbatch
+    if mode == "off":
+        return None
+    udf_items = [
+        (n, e)
+        for n, e in exprs.items()
+        if type(e) is expr_mod.BatchApplyExpression and len(e._args())
+    ]
+    if not udf_items:
+        return None
+    udf_names = {n for n, _ in udf_items}
+    pass_names = [n for n in exprs if n not in udf_names]
+    pre_program = _compile_program({n: exprs[n] for n in pass_names}, source)
+
+    def make_args_program(e: expr_mod.BatchApplyExpression):
+        arg_exprs = list(e.args_)
+        kw_exprs = list(e.kwargs_.values())
+
+        def args_program(batch: DeltaBatch):
+            def lookup(ref: ColumnReference) -> np.ndarray:
+                if ref.name == "id":
+                    return batch.keys
+                return batch.data[ref.name]
+
+            ctx = EvalContext(lookup, len(batch))
+            return (
+                [np.asarray(eval_expr(a, ctx)) for a in arg_exprs],
+                [np.asarray(eval_expr(a, ctx)) for a in kw_exprs],
+            )
+
+        return args_program
+
+    specs_cfg = []
+    for n, e in udf_items:
+        udf = getattr(e, "udf", None)
+        specs_cfg.append(
+            dict(
+                name=n,
+                args_program=make_args_program(e),
+                fn=e.fn,
+                kw_names=list(e.kwargs_.keys()),
+                propagate_none=e.propagate_none,
+                min_bucket=int(getattr(udf, "microbatch_min_bucket", 8)),
+                deterministic=bool(e.deterministic),
+            )
+        )
+    max_batch = max(1, min(
+        [cfg.microbatch_max_batch]
+        + [
+            int(getattr(e, "udf").microbatch_max_batch)
+            for _, e in udf_items
+            if getattr(getattr(e, "udf", None), "microbatch_max_batch", None)
+        ]
+    ))
+    out_columns = list(exprs.keys())
+    np_dtypes = schema.np_dtypes()
+    node_mode = "pending" if mode == "pending" else "hold"
+    flush_ms = cfg.microbatch_flush_ms
+
+    def factory() -> ops.MicrobatchApplyNode:
+        from pathway_tpu.internals.logical import current_build
+
+        build = current_build()
+        runtime = build.shared_runtime if build is not None else None
+        return ops.MicrobatchApplyNode(
+            out_columns,
+            pass_names,
+            pre_program,
+            [ops.MicrobatchUdfSpec(**sc) for sc in specs_cfg],
+            np_dtypes=np_dtypes,
+            mode=node_mode,
+            max_batch=max_batch,
+            flush_ms=flush_ms,
+            runtime=runtime,
+        )
+
+    return factory
 
 
 def _compile_single(e: ColumnExpression, source: Table) -> Callable[[DeltaBatch], np.ndarray]:

@@ -39,8 +39,14 @@ def annotate(exc: BaseException, op_name: str, frame: tuple[str, int, str] | Non
         note += f" created at {where}"
     try:
         exc.add_note(note)
-    except AttributeError:  # pre-3.11 safety
-        pass
+    except AttributeError:  # pre-3.11: emulate PEP 678's __notes__ list
+        try:
+            notes = getattr(exc, "__notes__", None)
+            if notes is None:
+                notes = exc.__notes__ = []
+            notes.append(note)
+        except Exception:
+            pass
 
 
 def run_annotated(node, method, *args):

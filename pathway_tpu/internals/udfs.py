@@ -212,6 +212,13 @@ class UDF:
     """Base class for user-defined functions; subclass with ``__wrapped__`` or use
     the ``@pw.udf`` decorator (reference ``internals/udfs/__init__.py:67``)."""
 
+    #: microbatch knobs honored for ``is_batched`` subclasses (see
+    #: ``engine.operators.MicrobatchApplyNode``): device launch chunk
+    #: (``None`` = the PATHWAY_MICROBATCH_MAX_BATCH default) and the smallest
+    #: padded bucket the jitted callee should ever see
+    microbatch_max_batch: int | None = None
+    microbatch_min_bucket: int = 8
+
     def __init__(
         self,
         *,
@@ -282,13 +289,20 @@ class UDF:
                 deterministic=self.deterministic,
             )
         if getattr(self, "is_batched", False):
-            # fn receives whole columns (lists) — TPU model UDFs (one jitted call
-            # per delta block); caching/retry wrappers don't apply per row
-            return expr_mod.BatchApplyExpression(
+            # fn receives whole columns (lists) — TPU model UDFs; dispatched via
+            # the cross-tick microbatcher (engine MicrobatchApplyNode) when the
+            # call is a top-level select column and PATHWAY_MICROBATCH allows,
+            # one jitted call per delta block otherwise; caching/retry wrappers
+            # don't apply per row
+            e = expr_mod.BatchApplyExpression(
                 self._resolve_fn(), rt, args=args, kwargs=kwargs,
                 propagate_none=self.propagate_none,
                 deterministic=self.deterministic,
             )
+            # the microbatch planner reads per-UDF knobs off the expression
+            # (microbatch_max_batch / microbatch_min_bucket class attrs)
+            e.udf = self
+            return e
         return expr_mod.ApplyExpression(
             fn, rt, args=args, kwargs=kwargs,
             propagate_none=self.propagate_none,

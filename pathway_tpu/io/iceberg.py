@@ -134,6 +134,11 @@ def _load_metadata(troot: str, version: int) -> dict | None:
             return _json.load(fh)
     except FileNotFoundError:
         return None
+    except ValueError:
+        # truncated metadata (a pre-r6 writer died mid json.dump — r6 writers
+        # publish atomically via link): treat as absent so the commit loop's
+        # hint fallback engages instead of crashing every later commit
+        return None
 
 
 def _snapshot_files(troot: str, meta: dict) -> list[str]:
@@ -195,7 +200,13 @@ def write(
         # swing must not trap every later commit in a FileExistsError spin
         while True:
             version = max(_current_version(troot), _max_version_on_disk(troot))
-            prev = _load_metadata(troot, _current_version(troot))
+            # prev must be the SAME version the commit builds upon: after a
+            # FileExistsError retry (or a hint lagging the disk), loading prev
+            # from the stale hint would build a manifest list that silently
+            # omits the winning writer's data files — a lost update
+            prev = _load_metadata(troot, version)
+            if prev is None and version != _current_version(troot):
+                prev = _load_metadata(troot, _current_version(troot))
             new_version = version + 1
             snap_id = int(_time.time_ns() % (2**62))
             mdir = _meta_dir(troot)
@@ -282,12 +293,23 @@ def write(
                 "snapshots": snapshots,
                 "current-snapshot-id": snap_id,
             }
+            # publish vN atomically: the file must appear fully written (a
+            # concurrent writer's max-version scan may json.load it the
+            # instant it exists) AND exclusively (exactly one writer may own
+            # vN). Write to a private tmp, then hard-link into place — link
+            # fails with FileExistsError when another writer won the version.
             vpath = os.path.join(mdir, f"v{new_version}.metadata.json")
+            tmp_meta = os.path.join(
+                mdir, f"v{new_version}.tmp-{os.getpid()}-{uuid.uuid4().hex[:8]}"
+            )
+            with open(tmp_meta, "w") as fh:
+                _json.dump(meta, fh)
             try:
-                with open(vpath, "x") as fh:
-                    _json.dump(meta, fh)
+                os.link(tmp_meta, vpath)
             except FileExistsError:
+                os.unlink(tmp_meta)
                 continue  # another writer won the version: retry on top of it
+            os.unlink(tmp_meta)
             tmp = os.path.join(mdir, f"version-hint.tmp{os.getpid()}")
             with open(tmp, "w") as fh:
                 fh.write(str(new_version))

@@ -250,10 +250,14 @@ class _TimedInputNode(ops.StreamInputNode):
         if emit_until <= self.idx:
             return super().poll(time)  # drains stray pushes (none normally)
         sl = slice(self.idx, emit_until)
+        # copies, not views: the columnarized fixture arrays are shared across
+        # every worker's build and across successive pw.run calls on the same
+        # fixture — a downstream in-place mutation of a view would corrupt the
+        # fixture for other workers/runs (ADVICE r5)
         batch = DeltaBatch(
-            self._keys_arr[sl],
-            self._diffs_arr[sl],
-            {c: a[sl] for c, a in self._data_arrs.items()},
+            self._keys_arr[sl].copy(),
+            self._diffs_arr[sl].copy(),
+            {c: a[sl].copy() for c, a in self._data_arrs.items()},
             time,
         )
         self.idx = emit_until

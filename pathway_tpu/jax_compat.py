@@ -1,0 +1,42 @@
+"""Version-skew shims for the jax API surface this repo targets.
+
+The code is written against the current public names (``jax.shard_map`` with
+``check_vma``, ``jax.enable_x64``); older stacks (0.4.x) ship the same
+functionality under ``jax.experimental`` with different spellings. These shims
+resolve whichever the installed jax provides, so a single import failure does
+not take the whole ``ops``/``parallel`` surface down with it (it previously
+broke collection of every test importing ``pathway_tpu.ops``).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+
+
+def shard_map(f, mesh, in_specs, out_specs, check: bool = False):
+    """``jax.shard_map`` (new) / ``jax.experimental.shard_map`` (0.4.x).
+
+    ``check`` maps onto ``check_vma`` (new) or ``check_rep`` (old) — both are
+    the per-output replication/varying-mesh-axes validator.
+    """
+    try:
+        sm = jax.shard_map  # jax >= 0.6
+    except AttributeError:
+        from jax.experimental.shard_map import shard_map as sm  # jax 0.4.x
+    try:
+        return sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, check_vma=check)
+    except TypeError:
+        return sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, check_rep=check)
+
+
+def enable_x64() -> Any:
+    """``jax.enable_x64`` context manager (new) / ``jax.experimental.enable_x64``
+    (0.4.x)."""
+    try:
+        return jax.enable_x64()
+    except AttributeError:
+        from jax.experimental import enable_x64 as _e
+
+        return _e()

@@ -125,7 +125,10 @@ def _layer_norm(x, g, b):
     ``_layer_norm_eps``."""
     x32 = x.astype(jnp.float32)
     mu = jnp.mean(x32, axis=-1, keepdims=True)
-    var = jnp.mean(x32 * x32, axis=-1, keepdims=True) - mu * mu
+    # clamp: catastrophic cancellation can push E[x²]−µ² slightly negative for
+    # near-constant rows with large mean, and rsqrt of a negative is NaN —
+    # max(·, 0) is free on the MXU (ADVICE r5)
+    var = jnp.maximum(jnp.mean(x32 * x32, axis=-1, keepdims=True) - mu * mu, 0.0)
     return ((x32 - mu) * jax.lax.rsqrt(var + 1e-6) * g + b).astype(x.dtype)
 
 

@@ -32,7 +32,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from jax import shard_map
+from pathway_tpu.jax_compat import shard_map
 
 
 class KnnMetric(enum.Enum):
@@ -558,7 +558,7 @@ def sharded_search(
         mesh=mesh,
         in_specs=(P(axis, None), P(axis), P(axis), P(axis), P(None, None)),
         out_specs=(P(None, None), P(None, None)),
-        check_vma=False,
+        check=False,
     )
     return fn(vectors, norms_sq, valid, key_bits, queries)
 

@@ -59,11 +59,14 @@ class MicrobatchDispatcher:
     def submit(self, item: Any) -> None:
         self._items.append(item)
 
-    def flush(self) -> list:
+    def flush(self, only_full: bool = False) -> list:
         """Run the batch fn over everything buffered; returns results in submit
-        order."""
+        order. ``only_full=True`` launches only complete ``max_batch`` chunks
+        (zero padding waste) and leaves the remainder buffered — the cross-tick
+        accumulation mode: the engine keeps feeding rows and flushes the tail
+        on its autocommit deadline."""
         out: list = []
-        while self._items:
+        while self._items and (not only_full or len(self._items) >= self.max_batch):
             chunk = self._items[: self.max_batch]
             del self._items[: self.max_batch]
             n = len(chunk)

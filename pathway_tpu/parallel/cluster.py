@@ -324,6 +324,7 @@ class ClusterRuntime:
         self.persistence: Any = None
         self.on_tick_done: list[Any] = []
         self._stop_requested = False
+        self.streaming = False  # set after build (see engine.runtime.Runtime)
         self.current_time = 0
         self.local_workers: dict[int, _LocalWorker] = {}
         # intra-process rows ride the local mesh; cross-process rows take the
@@ -358,6 +359,7 @@ class ClusterRuntime:
                 worker_index=w,
                 n_workers=self.n_workers,
                 register=self.register_connector,
+                shared_runtime=self,
             )
             for out in outputs:
                 ctx.resolve(out)
@@ -571,6 +573,7 @@ class ClusterRuntime:
     # ---------------------------------------------------------------- run loop
     def run(self, outputs: list[LogicalNode]):
         self._build(outputs)
+        self.streaming = bool(self.connectors)
         if self.pid == 0:
             self.coord.wait_connections()
         else:

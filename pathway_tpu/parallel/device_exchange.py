@@ -54,12 +54,15 @@ def _jitted_exchange(mesh, axis: str, n_cols: int, with_dest: bool = False):
     in_specs = [P(None, axis), P(axis), P(axis), [P(axis)] * n_cols]
     if with_dest:
         in_specs.append(P(axis))
+    from pathway_tpu.jax_compat import shard_map
+
     return jax.jit(
-        jax.shard_map(
+        shard_map(
             kern,
             mesh=mesh,
             in_specs=tuple(in_specs),
             out_specs=(P(None, axis), P(axis), P(axis), [P(axis)] * n_cols),
+            check=True,
         )
     )
 

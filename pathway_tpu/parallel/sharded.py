@@ -75,6 +75,7 @@ class ShardedRuntime:
         self.persistence: Any = None
         self.workers: list[_Worker] = []
         self._stop_requested = False
+        self.streaming = False  # set after build (see engine.runtime.Runtime)
         self.current_time = 0
         self.on_tick_done: list[Any] = []
         # on-device all_to_all exchange for numeric blocks (None = host-only;
@@ -101,6 +102,7 @@ class ShardedRuntime:
                 worker_index=w,
                 n_workers=self.n_workers,
                 register=self.register_connector,
+                shared_runtime=self,
             )
             for out in outputs:
                 ctx.resolve(out)
@@ -261,6 +263,7 @@ class ShardedRuntime:
         import time as _time
 
         self._build(outputs)
+        self.streaming = bool(self.connectors)
         if self.persistence is not None:
             self.persistence.on_graph_built(self._ctx0)
             self.on_tick_done.append(self.persistence.on_tick_done)

@@ -40,6 +40,10 @@ class SentenceTransformerEmbedder(BaseEmbedder):
     """
 
     is_batched = True
+    # cross-tick microbatcher knobs: 512 is the measured-best device batch
+    # (BENCH_r05 ``device_docs_per_s_by_batch``); buckets below 8 waste the MXU
+    microbatch_max_batch = 512
+    microbatch_min_bucket = 8
 
     _PRESETS = {
         "minilm": dict(d_model=384, n_heads=6, n_layers=6, d_ff=1536),
@@ -71,6 +75,10 @@ class SentenceTransformerEmbedder(BaseEmbedder):
             embs = encoder.encode_texts([str(t) for t in texts])
             return list(embs)
 
+        # deterministic: fixed weights, pure forward pass — lets the
+        # microbatch node recompute retract rows instead of remembering
+        # every emitted embedding
+        kwargs.setdefault("deterministic", True)
         super().__init__(_fn=embed_batch, return_type=np.ndarray, **kwargs)
 
     def get_embedding_dimension(self, **kwargs) -> int:

@@ -18,6 +18,9 @@ from pathway_tpu.internals.udfs import UDF
 
 class CrossEncoderReranker(UDF):
     is_batched = True
+    # cross-tick microbatcher knobs (see embedders.SentenceTransformerEmbedder)
+    microbatch_max_batch = 512
+    microbatch_min_bucket = 8
 
     def __init__(self, model: Any = None, *, seed: int = 0, **kwargs):
         from pathway_tpu.ops.encoder import EncoderConfig
@@ -35,6 +38,7 @@ class CrossEncoderReranker(UDF):
             pairs = [(str(q), str(d)) for q, d in zip(queries, docs)]
             return [float(s) for s in ce.score_pairs(pairs)]
 
+        kwargs.setdefault("deterministic", True)  # fixed weights, pure forward
         super().__init__(_fn=score_batch, return_type=float, **kwargs)
 
 
@@ -43,6 +47,8 @@ class EncoderReranker(UDF):
     (reference ``rerankers.py:224``)."""
 
     is_batched = True
+    microbatch_max_batch = 512
+    microbatch_min_bucket = 8
 
     def __init__(self, embedder, **kwargs):
         if not getattr(embedder, "is_batched", False):
@@ -59,6 +65,7 @@ class EncoderReranker(UDF):
             qv = np.stack(embed([str(q) for q in queries]))
             return [float(x) for x in np.sum(dv * qv, axis=-1)]
 
+        kwargs.setdefault("deterministic", True)  # fixed weights, pure forward
         super().__init__(_fn=score_batch, return_type=float, **kwargs)
 
 

@@ -108,3 +108,23 @@ def test_gated_connectors_raise_clearly():
             schema=pw.schema_from_types(v=int),
             format="json",
         )
+
+
+def test_timed_input_fast_path_emits_copies():
+    """ADVICE r5: the columnarized fixture arrays are shared across worker
+    builds and successive runs; emitted slices must be copies, or a downstream
+    in-place mutation corrupts the fixture for the next run (single-event
+    ticks bypassed consolidate's copying take)."""
+    import numpy as np
+
+    from pathway_tpu.io.python import _TimedInputNode
+
+    events = [(0, 1, (5,), 1)]
+    node = _TimedInputNode(events, ["x"], {"x": np.dtype(np.int64)})
+    [b] = node.poll(0)
+    b.data["x"][:] = 999  # a misbehaving consumer mutating in place
+    b.diffs[:] = -7
+    node.idx = 0  # second run over the same fixture
+    [b2] = node.poll(0)
+    assert b2.data["x"].tolist() == [5]
+    assert b2.diffs.tolist() == [1]

@@ -161,6 +161,76 @@ def test_iceberg_streaming_appends(tmp_path):
     assert sorted(got) == [("a", 1), ("b", 2)]
 
 
+def test_iceberg_two_writer_contention_keeps_both_commits(tmp_path):
+    """Lost-update regression (ADVICE r5): the optimistic commit builds the new
+    version on max(hint, disk) but previously loaded ``prev`` from the HINT
+    alone — when the hint lags the disk (a writer died after creating vN but
+    before the hint swing, or a FileExistsError retry), the next commit's
+    manifest list silently dropped the winner's durably-written data files."""
+    wh = str(tmp_path / "warehouse")
+    G.clear()
+    t1 = pw.debug.table_from_rows(pw.schema_from_types(w=str, n=int), [("a", 1)])
+    pw.io.iceberg.write(t1, wh, ["ns"], "t")
+    pw.run(monitoring_level="none")
+
+    # simulate writer A dying between creating v1 and swinging the hint: the
+    # metadata file exists on disk, the hint still says the prior version
+    mdir = os.path.join(wh, "ns", "t", "metadata")
+    hint = os.path.join(mdir, "version-hint.text")
+    v = int(open(hint).read().strip())
+    with open(hint, "w") as fh:
+        fh.write(str(v - 1))
+
+    # writer B commits into the contended table
+    G.clear()
+    t2 = pw.debug.table_from_rows(pw.schema_from_types(w=str, n=int), [("b", 2)])
+    pw.io.iceberg.write(t2, wh, ["ns"], "t")
+    pw.run(monitoring_level="none")
+
+    # BOTH writers' rows must be in the current snapshot
+    G.clear()
+    r = pw.io.iceberg.read(
+        wh, ["ns"], "t", schema=pw.schema_from_types(w=str, n=int), mode="static"
+    )
+    assert sorted(rows_of(r)) == [("a", 1), ("b", 2)]
+
+
+def test_iceberg_concurrent_writers_no_lost_rows(tmp_path):
+    """True two-writer contention: concurrent processes racing the version
+    file; every committed row must survive into the final snapshot."""
+    wh = str(tmp_path / "warehouse")
+    script = textwrap.dedent(
+        """
+        import sys
+        import pathway_tpu as pw
+        w = sys.argv[1]
+        t = pw.debug.table_from_rows(
+            pw.schema_from_types(w=str, n=int), [(w, int(sys.argv[2]))]
+        )
+        pw.io.iceberg.write(t, sys.argv[3], ["ns"], "t")
+        pw.run(monitoring_level="none")
+        """
+    )
+    procs = [
+        subprocess.Popen(
+            [sys.executable, "-c", script, f"w{i}", str(i), wh],
+            env=dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS="cpu"),
+            stdout=subprocess.PIPE,
+            stderr=subprocess.PIPE,
+        )
+        for i in range(3)
+    ]
+    for p in procs:
+        _out, err = p.communicate(timeout=120)
+        assert p.returncode == 0, err.decode()
+
+    G.clear()
+    r = pw.io.iceberg.read(
+        wh, ["ns"], "t", schema=pw.schema_from_types(w=str, n=int), mode="static"
+    )
+    assert sorted(rows_of(r)) == [("w0", 0), ("w1", 1), ("w2", 2)]
+
+
 def test_iceberg_retractions_net_out(tmp_path):
     wh = str(tmp_path / "warehouse")
 

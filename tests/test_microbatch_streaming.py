@@ -1,0 +1,283 @@
+"""Cross-tick device microbatching (ISSUE r6 tentpole).
+
+The dispatcher (``ops/microbatch.py``) is wired into the real UDF dispatch
+path: ``is_batched`` UDF rows buffer ACROSS streaming ticks per UDF, launch as
+padded power-of-two batches, and scatter back on the completing tick. These
+tests pin the correctness contract: byte-identity of final streaming results
+vs per-tick dispatch, retractions mid-buffer, per-row error poisoning,
+flush-on-deadline ordering, and the ``pending``/``await_futures`` discipline.
+"""
+
+from __future__ import annotations
+
+import time
+
+import numpy as np
+import pytest
+
+import pathway_tpu as pw
+from pathway_tpu.engine.blocks import DeltaBatch
+from pathway_tpu.engine.operators import MicrobatchApplyNode, MicrobatchUdfSpec
+from pathway_tpu.internals.errors import ERROR, PENDING
+from pathway_tpu.internals.parse_graph import G
+from pathway_tpu.internals.udfs import UDF
+from utils import keyed_rows_of, rows_of
+
+
+class _TrackingUdf(UDF):
+    """Deterministic batched UDF that records every launch's size and inputs."""
+
+    is_batched = True
+
+    def __init__(self, fn=None):
+        self.batches: list[list] = []
+        base = fn or (lambda x: x * 3 + 1)
+
+        def batch_fn(xs):
+            self.batches.append(list(xs))
+            return [base(x) for x in xs]
+
+        super().__init__(_fn=batch_fn, return_type=int)
+
+    @property
+    def seen(self) -> list:
+        return [x for b in self.batches for x in b]
+
+
+class KS(pw.Schema):
+    k: int = pw.column_definition(primary_key=True)
+    x: int
+
+
+# events: (k, x, time, diff) — inserts over 6 ticks with a retract+re-insert
+_EVENTS = (
+    [(i, 10 + i, i // 8, 1) for i in range(48)]
+    + [(3, 13, 2, -1), (3, 113, 3, 1)]  # upsert of k=3 mid-stream
+    + [(40, 50, 6, -1)]  # plain retract of a row inserted at tick 5
+)
+
+
+def _pipeline(u: UDF):
+    t = pw.debug.table_from_rows(KS, _EVENTS, is_stream=True)
+    s = t.select(t.k, y=u(t.x), parity=t.x % 2)
+    # a stateful consumer downstream: corrections must flow through groupby
+    g = s.groupby(s.parity).reduce(s.parity, total=pw.reducers.sum(s.y))
+    return s, g
+
+
+def test_streaming_results_identical_to_per_tick_dispatch(monkeypatch):
+    monkeypatch.setenv("PATHWAY_MICROBATCH", "off")
+    u_off = _TrackingUdf()
+    s, g = _pipeline(u_off)
+    rows_off, agg_off = keyed_rows_of(s), rows_of(g)
+
+    G.clear()
+    monkeypatch.setenv("PATHWAY_MICROBATCH", "auto")
+    u_on = _TrackingUdf()
+    s2, g2 = _pipeline(u_on)
+    rows_on, agg_on = keyed_rows_of(s2), rows_of(g2)
+
+    assert rows_on == rows_off
+    assert agg_on == agg_off
+    # the whole point: strictly fewer launches than the per-tick path, and
+    # power-of-two padded launch sizes
+    assert len(u_on.batches) < len(u_off.batches)
+    assert all((len(b) & (len(b) - 1)) == 0 for b in u_on.batches)
+
+
+def test_retraction_mid_buffer_cancels_launch(monkeypatch):
+    monkeypatch.setenv("PATHWAY_MICROBATCH", "auto")
+    # huge deadline: nothing flushes until the stream drains, so the tick-2
+    # retract of k=3 lands while its row is still buffered
+    monkeypatch.setenv("PATHWAY_MICROBATCH_FLUSH_MS", "60000")
+    u = _TrackingUdf()
+    t = pw.debug.table_from_rows(
+        KS, [(1, 10, 0, 1), (3, 13, 0, 1), (2, 20, 1, 1), (3, 13, 2, -1)],
+        is_stream=True,
+    )
+    s = t.select(t.k, y=u(t.x))
+    assert sorted(rows_of(s)) == [(1, 31), (2, 61)]
+    # the cancelled row never reached the device: 13 appears in no launch
+    # (pad rows repeat the LAST buffered row, which is never the cancelled one
+    # here), and exactly one launch covers the surviving rows
+    assert 13 not in u.seen
+    assert len(u.batches) == 1
+
+
+def test_udf_error_poisons_only_its_rows(monkeypatch):
+    monkeypatch.setenv("PATHWAY_MICROBATCH", "auto")
+
+    def explode(x):
+        if x == 13:
+            raise ValueError("bad row")
+        return x * 3 + 1
+
+    u = _TrackingUdf(fn=explode)
+    t = pw.debug.table_from_rows(
+        KS, [(i, 10 + i, i // 4, 1) for i in range(8)], is_stream=True
+    )
+    s = t.select(t.k, y=u(t.x))
+    rows = {row[0]: row for row in keyed_rows_of(s).values()}
+    assert rows[3] == (3, ERROR)
+    for k in [0, 1, 2, 4, 5, 6, 7]:
+        assert rows[k] == (k, (10 + k) * 3 + 1)
+
+
+def test_pending_mode_settles_through_await_futures(monkeypatch):
+    monkeypatch.setenv("PATHWAY_MICROBATCH", "pending")
+    u = _TrackingUdf()
+    t = pw.debug.table_from_rows(
+        KS, [(i, 10 + i, i // 4, 1) for i in range(8)], is_stream=True
+    )
+    s = t.select(t.k, y=u(t.x))
+    settled = s.await_futures()
+    from pathway_tpu.debug import _capture
+
+    cap = _capture(settled)
+    rows = {row[0]: tuple(row) for row in cap.rows.values()}
+    assert rows == {k: (k, (10 + k) * 3 + 1) for k in range(8)}
+    # no PENDING survives await_futures, at any tick
+    assert all(PENDING not in row for (_t, _k, _d, row) in cap.deltas)
+
+
+# ------------------------------------------------------------- node-level unit
+
+
+def _make_node(max_batch=64, runtime=None, flush_ms=None, mode="hold"):
+    calls: list[int] = []
+
+    def fn(xs):
+        calls.append(len(xs))
+        return [x * 2 for x in xs]
+
+    def args_program(batch):
+        return [np.asarray(batch.data["x"])], []
+
+    spec = MicrobatchUdfSpec("y", args_program, fn, [], False)
+    node = MicrobatchApplyNode(
+        ["y"], [], lambda b: {}, [spec],
+        np_dtypes={"y": np.dtype(np.int64)},
+        mode=mode, max_batch=max_batch, flush_ms=flush_ms, runtime=runtime,
+    )
+    return node, calls
+
+
+def _batch(keys, xs, time, diffs=None):
+    n = len(keys)
+    return DeltaBatch(
+        np.asarray(keys, dtype=np.uint64),
+        np.asarray(diffs if diffs is not None else [1] * n, dtype=np.int64),
+        {"x": np.asarray(xs, dtype=np.int64)},
+        time,
+    )
+
+
+class _LiveDriver:
+    def is_finished(self):
+        return False
+
+
+class _FakeRuntime:
+    streaming = True
+    autocommit_duration_ms = 5
+
+    def __init__(self):
+        self.connectors = [_LiveDriver()]
+
+
+def test_flush_on_deadline_ordering():
+    """A buffered row must launch within the autocommit deadline, at a LATER
+    tick than its arrival, and full chunks launch immediately."""
+    rt = _FakeRuntime()
+    node, calls = _make_node(max_batch=8, runtime=rt)
+    node.process([_batch([1, 2], [10, 20], 0)], 0)
+    assert node.on_frontier(0) == []  # fresh rows: held, latency budget intact
+    assert calls == []
+    time.sleep(0.01)  # > autocommit_duration_ms
+    out = node.on_frontier(3)
+    assert calls == [8]  # padded to the min bucket
+    [b] = out
+    assert b.time == 3  # scattered back on the completing tick
+    assert sorted(zip(b.keys.tolist(), b.data["y"].tolist())) == [(1, 20), (2, 40)]
+
+    # a full max_batch chunk launches in process(), before any deadline
+    node.process([_batch(list(range(10, 22)), list(range(12)), 4)], 4)
+    assert calls[1:] == [8]  # one full chunk of 8 launched, 4 rows remain
+    assert len(node.waiting) == 4
+
+
+def test_cross_tick_upsert_out_of_order_retract():
+    """A key with BOTH a settled row and a newer buffered version: a retract
+    must target whichever version its input values match — the old settled row
+    keeps flowing out, the buffered one keeps its launch."""
+    rt = _FakeRuntime()
+    node, calls = _make_node(max_batch=8, runtime=rt)
+    node.process([_batch([5], [10], 0)], 0)
+    time.sleep(0.01)
+    [b1] = node.on_frontier(1)  # v1 settles: y = 20
+    assert b1.data["y"].tolist() == [20]
+
+    # new version buffered, then the OLD version's retract arrives
+    node.process([_batch([5], [11], 2)], 2)
+    [b2] = node.process([_batch([5], [10], 3, diffs=[-1])], 3)
+    assert b2.diffs.tolist() == [-1]
+    assert b2.data["y"].tolist() == [20]  # retracts settled v1, not buffered v2
+    time.sleep(0.01)
+    [b3] = node.on_frontier(4)
+    assert b3.data["y"].tolist() == [22]  # v2 still launches
+
+    # and the converse: retract of the BUFFERED version cancels in-buffer
+    node.process([_batch([5], [12], 5)], 5)
+    launches_before = list(calls)
+    out = node.process([_batch([5], [12], 6, diffs=[-1])], 6)
+    assert out == [] or all(b.is_empty for b in out)
+    time.sleep(0.01)
+    assert node.on_frontier(7) == []  # nothing left to flush
+    assert calls == launches_before  # the cancelled row never launched
+
+
+def test_retract_exceeding_buffered_count_reaches_settled_row():
+    """consolidate may merge retracts of a buffered copy AND a settled copy of
+    one key into a single diff — the excess beyond the buffered count must
+    retract the settled row, not vanish."""
+    rt = _FakeRuntime()
+    node, calls = _make_node(max_batch=8, runtime=rt)
+    node.process([_batch([5], [10], 0)], 0)
+    time.sleep(0.01)
+    node.on_frontier(1)  # first copy settles downstream
+    node.process([_batch([5], [10], 2)], 2)  # identical second copy buffered
+    [b] = node.process([_batch([5], [10], 3, diffs=[-2])], 3)
+    assert b.diffs.tolist() == [-1]
+    assert b.data["y"].tolist() == [20]  # the settled row is retracted
+    assert not node.waiting and not node.emitted
+
+
+def test_retract_of_buffered_nan_row_cancels():
+    """NaN inputs: NaN != NaN must not defeat the retract-vs-buffer value
+    match — the retract cancels in-buffer, nothing phantom flows downstream."""
+    rt = _FakeRuntime()
+    node, calls = _make_node(max_batch=8, runtime=rt)
+
+    def nan_batch(diffs):
+        return DeltaBatch(
+            np.asarray([7], dtype=np.uint64),
+            np.asarray(diffs, dtype=np.int64),
+            {"x": np.asarray([float("nan")], dtype=np.float64)},
+            0,
+        )
+
+    node.process([nan_batch([1])], 0)
+    out = node.process([nan_batch([-1])], 1)
+    assert out == [] or all(b.is_empty for b in out)
+    assert not node.waiting
+    time.sleep(0.01)
+    assert node.on_frontier(2) == []
+    assert calls == []  # the cancelled row never launched
+
+
+def test_static_run_flushes_at_its_single_tick():
+    node, calls = _make_node(runtime=None)  # no runtime = static discipline
+    node.process([_batch([1], [5], 0)], 0)
+    out = node.on_frontier(0)
+    assert calls == [8]
+    assert out[0].time == 0

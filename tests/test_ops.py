@@ -160,6 +160,25 @@ def test_encoder_deterministic_unit_norm():
     assert a @ b > a @ c
 
 
+def test_layer_norm_near_constant_large_mean_no_nan():
+    """Regression (ADVICE r5): the single-pass var = E[x²] − µ² cancels
+    catastrophically for near-constant rows with large mean, going slightly
+    negative in f32 — rsqrt then yields NaN embeddings without the clamp."""
+    import jax.numpy as jnp
+
+    from pathway_tpu.ops.encoder import _layer_norm
+
+    g = jnp.ones((384,))
+    b = jnp.zeros((384,))
+    # exactly constant at a magnitude where f32 E[x²] − µ² < −1e-6 (measured)
+    x = jnp.full((2, 3, 384), 7.3, dtype=jnp.float32)
+    assert bool(jnp.isfinite(_layer_norm(x, g, b)).all())
+    # near-constant with large mean
+    noise = jnp.linspace(0, 1e-4, 384, dtype=jnp.float32)
+    x2 = jnp.full((1, 1, 384), 101.3, dtype=jnp.float32) + noise
+    assert bool(jnp.isfinite(_layer_norm(x2, g, b)).all())
+
+
 def test_encoder_padding_invariance():
     """Mask discipline: extra padding must not change embeddings."""
     enc = JaxSentenceEncoder(SMALL, seed=0)
