@@ -1,0 +1,124 @@
+"""Recovery benchmark: time-to-recover vs history length (ISSUE 2).
+
+Measures the resilience subsystem's restart cost for a wordcount-shaped
+pipeline over a file-backed persistence store, comparing the two recovery
+strategies:
+
+- ``persisting`` (input-log only): restart replays the WHOLE event log —
+  O(history) recompute;
+- ``operator_persisting``: restart restores node-state snapshots and replays
+  only the log suffix past the committed epoch — O(state + suffix).
+
+Each run: session 1 ingests ``n`` events and commits snapshots/epochs; the
+"crash" is the session boundary (same storage, fresh runtime — the in-process
+analogue of SIGKILL + Supervisor relaunch, see
+``tests/test_resilience.py::test_supervisor_cluster_kill_recovery`` for the
+real-subprocess version); session 2 re-opens the store with ``suffix`` new
+events and we time it to completion, recording how many events the
+persistence layer actually replayed (``resilience.replay`` telemetry).
+
+Usage: python benchmarks/recovery_bench.py [n_events] [suffix_events]
+Prints one JSON line per mode.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _session(broker_path: str, expected: int, pstore: str, mode: str) -> dict:
+    """One pipeline lifetime over a seekable (kafka-shaped) source: run until
+    the count aggregate covers ``expected`` events, then stop. The source
+    seeks past persisted offsets on restart, so recovery cost is exactly the
+    log-replay + state-restore work — the quantity the two modes differ in."""
+    import pathway_tpu as pw
+    from pathway_tpu.internals import telemetry
+    from pathway_tpu.internals.parse_graph import G
+    from pathway_tpu.io.kafka import MockKafkaBroker
+
+    class Stop:
+        hit = False
+
+    G.clear()
+    telemetry.clear_events()
+    broker = MockKafkaBroker(path=broker_path)
+    words = pw.io.kafka.read(
+        broker, "words", format="plaintext", mode="streaming", name="words"
+    )
+    agg = words.groupby(words.data).reduce(words.data, c=pw.reducers.count())
+    total = agg.reduce(s=pw.reducers.sum(pw.this.c))
+
+    def on_total(key, row, time, is_addition):
+        if is_addition and row["s"] >= expected:
+            Stop.hit = True
+            rt = pw.internals.run.current_runtime()
+            if rt is not None:
+                rt.request_stop()
+
+    pw.io.subscribe(total, on_change=on_total)
+    t0 = time.perf_counter()
+    pw.run(
+        monitoring_level="none",
+        persistence_config=pw.persistence.Config(
+            backend=pw.persistence.Backend.filesystem(pstore),
+            persistence_mode=mode,
+            snapshot_interval_ms=500,
+        ),
+    )
+    dt = time.perf_counter() - t0
+    assert Stop.hit, "run finished before reaching the expected count"
+    replays = telemetry.events("resilience.replay")
+    return {
+        "seconds": dt,
+        "replayed": sum(e["attrs"]["events"] for e in replays),
+    }
+
+
+def bench_mode(mode: str, n: int, suffix: int, root: str) -> dict:
+    import pathway_tpu as pw
+    from pathway_tpu.io.kafka import MockKafkaBroker
+
+    broker_path = os.path.join(root, f"broker-{mode}")
+    pstore = os.path.join(root, f"pstore-{mode}")
+    shutil.rmtree(pstore, ignore_errors=True)
+    broker = MockKafkaBroker(path=broker_path)
+    broker.create_topic("words", partitions=1)
+    for i in range(n):
+        broker.produce("words", f"w{i % 4096}")
+    first = _session(broker_path, n, pstore, mode)
+    # the "crash": session boundary over the same storage; new data arrives
+    # while the pipeline is down, then the relaunch recovers + catches up
+    for i in range(n, n + suffix):
+        broker.produce("words", f"w{i % 4096}")
+    second = _session(broker_path, n + suffix, pstore, mode)
+    epoch = pw.persistence.last_committed_epoch(
+        pw.persistence.Backend.filesystem(pstore)
+    )
+    return {
+        "metric": f"recovery {mode}",
+        "history_events": n,
+        "suffix_events": suffix,
+        "ingest_seconds": round(first["seconds"], 3),
+        "recovery_seconds": round(second["seconds"], 3),
+        "replayed_events": second["replayed"],
+        "last_epoch": epoch["epoch"] if epoch else None,
+    }
+
+
+def main() -> None:
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 200_000
+    suffix = int(sys.argv[2]) if len(sys.argv) > 2 else 2_000
+    with tempfile.TemporaryDirectory() as root:
+        for mode in ("persisting", "operator_persisting"):
+            print(json.dumps(bench_mode(mode, n, suffix, root)), flush=True)
+
+
+if __name__ == "__main__":
+    main()

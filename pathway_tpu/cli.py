@@ -90,6 +90,39 @@ def spawn_from_env(program):
 
 
 @cli.command(context_settings={"ignore_unknown_options": True})
+@click.option("-t", "--threads", type=int, default=None, help="workers per process")
+@click.option("-n", "--processes", type=int, default=None, help="number of processes")
+@click.option("--first-port", type=int, default=None, help="base TCP port for the cluster plane")
+@click.option("--max-restarts", type=int, default=None, help="restart budget on failure")
+@click.option("--backoff", type=float, default=None, help="base restart backoff seconds")
+@click.option("--log-dir", type=str, default=None, help="capture child output per attempt")
+@click.argument("program", nargs=-1, type=click.UNPROCESSED)
+def supervise(threads, processes, first_port, max_restarts, backoff, log_dir, program):
+    """Run PROGRAM under the resilience Supervisor: spawn the cluster, detect
+    a failed process, relaunch from the last committed checkpoint epoch with
+    bounded exponential backoff (``resilience.Supervisor``)."""
+    from pathway_tpu.resilience import Supervisor, SupervisorGaveUp
+
+    if not program:
+        raise click.UsageError("no program given (e.g. `supervise -n 2 python script.py`)")
+    try:
+        result = Supervisor(
+            list(program),
+            threads=threads,
+            processes=processes,
+            first_port=first_port,
+            max_restarts=max_restarts,
+            backoff_s=backoff,
+            log_dir=log_dir,
+        ).run()
+    except SupervisorGaveUp as e:
+        raise click.ClickException(str(e)) from e
+    if result.restarts:
+        click.echo(f"pathway_tpu supervise: recovered after {result.restarts} restart(s)")
+    sys.exit(0)
+
+
+@cli.command(context_settings={"ignore_unknown_options": True})
 @click.option("--record-path", type=str, default="./record", help="recorded persistence root")
 @click.option(
     "--mode",

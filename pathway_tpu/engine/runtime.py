@@ -65,6 +65,9 @@ class Runtime:
         self._stop_requested = True
 
     def run(self, outputs: list[LogicalNode]) -> Scheduler:
+        from pathway_tpu.resilience import faults as _faults
+
+        _faults.install_from_env()
         ctx = build_engine_graph(outputs, runtime=self)
         self.streaming = bool(self.connectors)
         scheduler = Scheduler(ctx.graph)
@@ -81,6 +84,7 @@ class Runtime:
 
         if not self.connectors:
             # static mode: single batch tick
+            _faults.on_tick_start(0, 0)
             scheduler.run_tick(0)
             scheduler.close()
             if self.persistence is not None:
@@ -93,6 +97,12 @@ class Runtime:
         try:
             while not self._stop_requested:
                 t0 = _time.perf_counter()
+                if _faults.on_tick_start(0, tick):
+                    # drop_poll fault: this tick is skipped entirely — events
+                    # keep buffering in the input nodes for the next tick
+                    tick += 1
+                    _time.sleep(period)
+                    continue
                 scheduler.run_tick(tick)
                 tick += 1
                 check_connector_failures(self.connectors)

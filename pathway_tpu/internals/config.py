@@ -58,6 +58,38 @@ class PathwayConfig:
     def barrier_timeout(self) -> float:
         return _env_float("PATHWAY_BARRIER_TIMEOUT", 120.0)
 
+    # ---- resilience ---------------------------------------------------------
+    @property
+    def heartbeat_interval(self) -> float:
+        """Seconds between peer→coordinator heartbeats on the cluster control
+        plane; <=0 disables failure detection (barriers then fall back to the
+        bare ``barrier_timeout``)."""
+        return _env_float("PATHWAY_HEARTBEAT_INTERVAL", 0.5)
+
+    @property
+    def heartbeat_timeout(self) -> float:
+        """Seconds of heartbeat silence before a connected-but-quiet peer is
+        declared dead (a peer whose process exits is detected immediately via
+        connection EOF). Clamped so detection always lands within
+        ``barrier_timeout``."""
+        return min(
+            _env_float("PATHWAY_HEARTBEAT_TIMEOUT", 10.0), self.barrier_timeout
+        )
+
+    @property
+    def fault_plan(self) -> str | None:
+        """Fault-injection plan (``resilience.FaultPlan`` syntax), e.g.
+        ``kill:proc=1,tick=40;drop_poll:proc=0,tick=3,count=2``."""
+        return os.environ.get("PATHWAY_FAULT_PLAN") or None
+
+    @property
+    def supervisor_max_restarts(self) -> int:
+        return _env_int("PATHWAY_SUPERVISOR_MAX_RESTARTS", 3)
+
+    @property
+    def supervisor_backoff_s(self) -> float:
+        return _env_float("PATHWAY_SUPERVISOR_BACKOFF", 0.5)
+
     # ---- persistence / replay ----------------------------------------------
     @property
     def persistent_storage(self) -> str | None:
@@ -173,6 +205,9 @@ class PathwayConfig:
                 "process_id",
                 "first_port",
                 "barrier_timeout",
+                "heartbeat_interval",
+                "heartbeat_timeout",
+                "fault_plan",
                 "persistent_storage",
                 "replay_storage",
                 "replay_mode",

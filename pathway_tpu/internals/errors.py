@@ -52,6 +52,38 @@ class EngineErrorWithTrace(EngineError):
     pass
 
 
+class OtherWorkerError(EngineError):
+    """A cluster peer process died or stopped responding.
+
+    Structured counterpart of the reference's worker-panic surfacing (SURVEY
+    §5.3: a worker panic propagates as ``OtherWorkerError`` to the survivors,
+    recovery = restart + persistence replay). Raised by the cluster barrier /
+    heartbeat plane instead of a bare ``RuntimeError`` so supervisors and
+    operators can see WHICH process failed and WHEN:
+
+    - ``process_id``: the dead peer's ``PATHWAY_PROCESS_ID`` (None if unknown —
+      e.g. a startup timeout before any peer identified itself),
+    - ``tick``: the last logical tick the peer was known alive at (None if it
+      never reported one),
+    - ``reason``: short machine-readable cause — ``"disconnected"``,
+      ``"heartbeat-timeout"``, ``"barrier-timeout"``, ``"never-joined"``,
+      ``"coordinator-lost"``.
+    """
+
+    def __init__(
+        self,
+        message: str,
+        *,
+        process_id: int | None = None,
+        tick: int | None = None,
+        reason: str = "unknown",
+    ):
+        super().__init__(message)
+        self.process_id = process_id
+        self.tick = tick
+        self.reason = reason
+
+
 # -- error policy (reference: terminate_on_error flag threaded into the engine,
 # ``src/engine/error.rs`` + ``internals/run.py``) ------------------------------
 

@@ -82,6 +82,8 @@ def _visible_operators(ops: list[dict], level: str) -> list[dict]:
 
 
 def run_stats(runtime) -> dict[str, Any]:
+    from pathway_tpu.internals.telemetry import resilience_summary
+
     scheduler = getattr(runtime, "scheduler", None)
     ops = scheduler_stats(scheduler)
     return {
@@ -90,6 +92,10 @@ def run_stats(runtime) -> dict[str, Any]:
         "operators": ops,
         "rows_in_total": sum(o["rows_in"] for o in ops),
         "rows_out_total": sum(o["rows_out"] for o in ops),
+        # recovery observability: heartbeat misses, committed checkpoint
+        # epochs, replayed events and supervised restarts, from the same
+        # event log the OTLP exports consume (``internals/telemetry.py``)
+        "resilience": resilience_summary(),
     }
 
 

@@ -84,6 +84,11 @@ def run(
 
         warnings.warn("pw.run(): no outputs registered; nothing to do")
         return
+    # per-run telemetry: the resilience event log (and its exports/status
+    # views) describes THIS run, not every run this process ever did
+    from pathway_tpu.internals import telemetry as _telemetry_reset
+
+    _telemetry_reset.clear_events()
     runtime = make_runtime(
         n_workers=n_workers,
         monitoring_level=monitoring_level,

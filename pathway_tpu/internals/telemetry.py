@@ -36,6 +36,83 @@ _DISABLED = object()
 _trace_file_override: Any = _UNSET
 _metrics_file_override: Any = _UNSET
 
+# -- resilience event log ------------------------------------------------------
+# Cross-cutting recovery events (heartbeat-miss, checkpoint-epoch-committed,
+# replay, fault injection, supervised restart) recorded by whichever subsystem
+# observes them and exported through the SAME OTLP trace/metrics documents as
+# the operator stats — so a recovery is visible in the run's own telemetry
+# (ISSUE 2 satellite; reference: telemetry.rs exports trace AND metrics).
+
+import threading as _threading
+import time as _time_mod
+
+#: bound on the retained raw events — long streaming runs commit an epoch per
+#: tick with moving offsets (~50/s at the default autocommit), so the raw log
+#: keeps only the most recent window while the counters below stay exact
+_EVENTS_MAX = 4096
+
+_events: list[dict] = []
+_events_lock = _threading.Lock()
+_counters: dict[str, int] = {}
+_last_epoch: int | None = None
+_replayed_total = 0
+
+
+def record_event(kind: str, **attrs: Any) -> dict:
+    """Record one resilience/lifecycle event. ``kind`` is a dotted name like
+    ``resilience.heartbeat_miss``; attrs must be OTLP-attribute-friendly
+    scalars. The raw log is bounded (oldest dropped past ``_EVENTS_MAX``);
+    per-kind counters and the epoch/replay aggregates are exact regardless."""
+    global _last_epoch, _replayed_total
+    ev = {"kind": kind, "ts_ns": _time_mod.time_ns(), "attrs": dict(attrs)}
+    with _events_lock:
+        _events.append(ev)
+        if len(_events) > _EVENTS_MAX:
+            del _events[: len(_events) - _EVENTS_MAX]
+        _counters[kind] = _counters.get(kind, 0) + 1
+        if kind == "resilience.epoch_committed":
+            _last_epoch = attrs.get("epoch", _last_epoch)
+        elif kind == "resilience.replay":
+            _replayed_total += int(attrs.get("events", 0))
+    return ev
+
+
+def events(kind: str | None = None) -> list[dict]:
+    with _events_lock:
+        snap = list(_events)
+    if kind is None:
+        return snap
+    return [e for e in snap if e["kind"] == kind]
+
+
+def clear_events() -> None:
+    """Reset the event log and aggregates — called at the start of every
+    ``pw.run`` so /status and the exported documents describe THIS run."""
+    global _last_epoch, _replayed_total
+    with _events_lock:
+        _events.clear()
+        _counters.clear()
+        _last_epoch = None
+        _replayed_total = 0
+
+
+def resilience_summary() -> dict[str, Any]:
+    """Aggregate view of the recorded events (monitoring /status + metrics)."""
+    with _events_lock:
+        counters = dict(_counters)
+        last_epoch = _last_epoch
+        replayed = _replayed_total
+    return {
+        "heartbeat_misses": counters.get("resilience.heartbeat_miss", 0),
+        "last_committed_epoch": last_epoch,
+        "replayed_events": replayed,
+        "restarts": counters.get("resilience.restart", 0),
+        "faults_injected": sum(
+            v for k, v in counters.items() if k.startswith("resilience.fault")
+        ),
+        "events": sum(counters.values()),
+    }
+
 
 def set_monitoring_config(*, trace_file: Any = _UNSET, metrics_file: Any = _UNSET) -> None:
     """Runtime override of the trace/metrics destinations (reference:
@@ -166,6 +243,22 @@ def export_run_trace(
                 "attributes": attrs,
             }
         )
+    # resilience events ride the same trace as zero-duration child spans so a
+    # recovery (replay, heartbeat miss, epoch commit) is visible inline with
+    # the operators it affected
+    for ev in events():
+        spans.append(
+            {
+                "traceId": trace_id,
+                "spanId": secrets.token_hex(8),
+                "parentSpanId": root_id,
+                "name": f"event/{ev['kind']}",
+                "kind": 1,
+                "startTimeUnixNano": str(ev["ts_ns"]),
+                "endTimeUnixNano": str(ev["ts_ns"]),
+                "attributes": [_attr(k, v) for k, v in ev["attrs"].items()],
+            }
+        )
     doc = {
         "resourceSpans": [
             {
@@ -181,6 +274,66 @@ def export_run_trace(
                         "spans": spans,
                     }
                 ],
+            }
+        ]
+    }
+    tmp = f"{path}.tmp{os.getpid()}"
+    with open(tmp, "w") as fh:
+        json.dump(doc, fh)
+    os.replace(tmp, path)
+    return doc
+
+
+def export_spans(
+    path: str,
+    spans_in: list[tuple[str, int, int, dict]],
+    *,
+    scope: str = "pathway_tpu.resilience",
+    root_name: str | None = None,
+) -> dict:
+    """Write a standalone OTLP/JSON trace document from (name, start_ns,
+    end_ns, attrs) tuples — used by processes that have no engine runtime
+    (e.g. the ``resilience.Supervisor`` parent recording restart spans).
+    Returns the document."""
+    trace_id = secrets.token_hex(16)
+    root_id = None
+    spans: list[dict] = []
+    if root_name is not None and spans_in:
+        root_id = secrets.token_hex(8)
+        spans.append(
+            {
+                "traceId": trace_id,
+                "spanId": root_id,
+                "name": root_name,
+                "kind": 1,
+                "startTimeUnixNano": str(min(s[1] for s in spans_in)),
+                "endTimeUnixNano": str(max(s[2] for s in spans_in)),
+                "attributes": [],
+            }
+        )
+    for name, start_ns, end_ns, attrs in spans_in:
+        span = {
+            "traceId": trace_id,
+            "spanId": secrets.token_hex(8),
+            "name": name,
+            "kind": 1,
+            "startTimeUnixNano": str(start_ns),
+            "endTimeUnixNano": str(end_ns),
+            "attributes": [_attr(k, v) for k, v in attrs.items()],
+        }
+        if root_id is not None:
+            span["parentSpanId"] = root_id
+        spans.append(span)
+    doc = {
+        "resourceSpans": [
+            {
+                "resource": {
+                    "attributes": [
+                        _attr("service.name", "pathway_tpu"),
+                        _attr("process.pid", os.getpid()),
+                    ]
+                },
+                "scopeSpans": [{"scope": {"name": scope, "version": "1"}, "spans": spans}],
             }
         ]
     }
@@ -241,6 +394,35 @@ def export_run_metrics(runtime, path: str, ts_ns: int) -> dict:
     ]
     if per_op["pathway.operator.lag"]:
         metrics.append(gauge("pathway.operator.lag", "1", per_op["pathway.operator.lag"]))
+    res = resilience_summary()
+    if res["events"]:
+        metrics.append(
+            gauge(
+                "pathway.resilience.heartbeat_misses",
+                "1",
+                [point(int(res["heartbeat_misses"]), [])],
+            )
+        )
+        metrics.append(
+            gauge(
+                "pathway.resilience.replayed_events",
+                "{rows}",
+                [point(int(res["replayed_events"]), [])],
+            )
+        )
+        metrics.append(
+            gauge(
+                "pathway.resilience.restarts", "1", [point(int(res["restarts"]), [])]
+            )
+        )
+        if res["last_committed_epoch"] is not None:
+            metrics.append(
+                gauge(
+                    "pathway.resilience.last_committed_epoch",
+                    "1",
+                    [point(int(res["last_committed_epoch"]), [])],
+                )
+            )
     doc = {
         "resourceMetrics": [
             {

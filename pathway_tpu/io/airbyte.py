@@ -215,11 +215,41 @@ def read(
         def __init__(self) -> None:
             super().__init__()
             self._stop = False
-            self._state: Any = None
+            # per-stream STATE registry (ADVICE r5): Airbyte emits one STATE
+            # message per stream descriptor (type STREAM), or a single GLOBAL /
+            # LEGACY document. Keeping only the LAST message dropped every
+            # other stream's cursor, so a multi-stream incremental sync
+            # re-synced all but one stream from scratch on the next read.
+            # Keyed by descriptor; the merged document hands back on read.
+            self._states: dict[tuple, Any] = {}
             # live keys of full-refresh streams from the previous poll — a
             # re-read that no longer contains a key retracts it (upstream
             # deletion); incremental streams are append-only
             self._fr_live: set[int] = set()
+
+        def _on_state(self, state: Any) -> None:
+            stype = state.get("type") if isinstance(state, dict) else None
+            if stype == "STREAM":
+                desc = (state.get("stream") or {}).get("stream_descriptor") or {}
+                key = ("STREAM", desc.get("name"), desc.get("namespace"))
+            elif stype == "GLOBAL":
+                key = ("GLOBAL", None, None)
+            else:  # legacy state blob ({"data": …} or a bare cursor document)
+                key = ("LEGACY", None, None)
+            self._states[key] = state
+
+        def _merged_state(self) -> Any:
+            """The state document for the next ``read``: a list of
+            AirbyteStateMessages (per-stream / global), or — for legacy-only
+            connectors — the bare legacy blob, matching what they emitted."""
+            if not self._states:
+                return None
+            if set(self._states) == {("LEGACY", None, None)}:
+                legacy = self._states[("LEGACY", None, None)]
+                if isinstance(legacy, dict) and "data" in legacy:
+                    return legacy["data"]
+                return legacy
+            return [self._states[k] for k in sorted(self._states, key=str)]
 
         def run(self) -> None:
             import warnings
@@ -232,7 +262,7 @@ def read(
             }
             while not self._stop:
                 try:
-                    messages = runner.read(source_config, catalog, self._state)
+                    messages = runner.read(source_config, catalog, self._merged_state())
                 except Exception as e:  # noqa: BLE001 — transient connector errors retry
                     if mode == "static":
                         raise
@@ -265,7 +295,7 @@ def read(
                         if stream in full_refresh:
                             fr_seen.add(key)
                     elif t == "STATE":
-                        self._state = m.get("state")
+                        self._on_state(m.get("state") or {})
                 # upstream deletions in full-refresh streams: keys present
                 # last poll but absent now retract (upsert session delete)
                 if mode == "streaming":
@@ -290,13 +320,23 @@ def read(
         # happen across a restart still retract
         def offset_state(self) -> dict:
             return {
-                "airbyte_state": self._state,
+                # the merged doc under the legacy key keeps old snapshots
+                # readable; the per-stream registry restores losslessly
+                "airbyte_state": self._merged_state(),
+                "airbyte_states": dict(self._states),
                 "fr_live": sorted(self._fr_live),
                 "seq": self._seq,
             }
 
         def seek(self, state: dict) -> None:
-            self._state = state.get("airbyte_state")
+            if "airbyte_states" in state:
+                self._states = dict(state["airbyte_states"])
+            else:
+                # snapshot from before per-stream states: a single opaque doc
+                legacy = state.get("airbyte_state")
+                self._states = (
+                    {("LEGACY", None, None): legacy} if legacy is not None else {}
+                )
             self._fr_live = set(state.get("fr_live", []))
             self._seq = int(state.get("seq", 0))
 

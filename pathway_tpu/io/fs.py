@@ -292,13 +292,28 @@ def _row_formatter(format: str, cols: list[str]):  # noqa: A002
 
 
 def _write_sharded(table: Table, filename: str, *, format: str, **kwargs: Any) -> None:  # noqa: A002
-    """Per-worker sink shards + ordered merge-commit (VERDICT r4 #2)."""
+    """Per-worker sink shards + ordered merge-commit (VERDICT r4 #2).
+
+    Persistence (ISSUE 2 satellite, ADVICE r5): part files get the same
+    lazy-open + per-part offset snapshot/restore hooks as the solo writer —
+    each worker's replica snapshots ITS part's durable offset with the
+    operator generation and a restart rewinds that part to the cut, so a
+    kill mid-stream can no longer truncate previously-committed part rows.
+    A restart AFTER the parts merge-committed (parts deleted) restores a
+    ``merged`` marker instead: re-appending to a merged output is
+    unsupported and raises a clear error rather than corrupting it."""
     import heapq
 
     cols = table.column_names()
     line_fn, header = _row_formatter(format, cols)
     lock = threading.Lock()
-    state: dict[str, Any] = {"parts": {}, "closed": set(), "n_workers": 1}
+    state: dict[str, Any] = {
+        "parts": {},
+        "closed": set(),
+        "n_workers": 1,
+        "merged_done": False,
+        "restored_merged": False,
+    }
 
     def _merge() -> None:
         """All shards closed: merge parts into ``filename`` ordered by
@@ -344,22 +359,102 @@ def _write_sharded(table: Table, filename: str, *, format: str, **kwargs: Any) -
         w = ctx.worker_index if ctx is not None else 0
         n = ctx.n_workers if ctx is not None else 1
         part_path = f"{filename}.part-{w:04d}"
-        fh = open(part_path, "w", newline="")
-        if header is not None:
-            fh.write(header)
         with lock:
             state["parts"][w] = part_path
             state["n_workers"] = max(state["n_workers"], n)
+        # LAZY open (same rule as the solo writer): opening "w" at graph build
+        # would truncate a previous run's part BEFORE restore_sink can rewind
+        # it to the snapshot cut
+        pstate: dict[str, Any] = {"fh": None, "final_offset": None}
+
+        def _ensure_open():
+            if pstate["fh"] is None:
+                if state["restored_merged"]:
+                    raise RuntimeError(
+                        f"fs.write(sharded=True) restore: {filename!r} was "
+                        "already merge-committed by the previous run; "
+                        "appending new rows to a merged output is not "
+                        "supported — remove the output file and the "
+                        "persistence storage to start fresh"
+                    )
+                fh = open(part_path, "w", newline="")
+                if header is not None:
+                    fh.write(header)
+                pstate["fh"] = fh
+            return pstate["fh"]
 
         def on_batch(batch: DeltaBatch, columns: list[str]) -> None:
+            fh = _ensure_open()
             for _key, diff, row in batch.rows():
                 fh.write(line_fn(row, batch.time, diff))
             fh.flush()
 
+        def sink_state() -> dict:
+            """This part's durable offset at a quiesced tick boundary (or the
+            merged marker once the parts were merge-committed)."""
+            with lock:
+                if state["merged_done"] or state["restored_merged"]:
+                    return {"merged": True}
+                fh = pstate["fh"]
+                if fh is None or fh.closed:
+                    return {"offset": pstate["final_offset"]}
+                fh.flush()
+                return {"offset": fh.tell()}
+
+        def restore_sink(s: dict) -> None:
+            with lock:
+                if s.get("merged"):
+                    state["restored_merged"] = True
+                    return
+                if pstate["fh"] is not None:
+                    return
+                off = s.get("offset")
+                if off is None:
+                    return  # nothing durably written at the snapshot: fresh part
+                if not os.path.exists(part_path):
+                    # the snapshot says this part held `off` bytes but the part
+                    # is gone — parts are only ever removed by _merge(), so a
+                    # crash landed between the merge-commit and the at-close
+                    # snapshot. The merged output IS the completed run; treat
+                    # it as merged (appending later raises the clear error)
+                    # rather than silently re-merging only the replayed tail
+                    # over it. A missing merged file too means outside
+                    # interference — refuse.
+                    if os.path.exists(filename):
+                        state["restored_merged"] = True
+                        return
+                    raise RuntimeError(
+                        f"fs.write(sharded=True) restore: {part_path!r} is "
+                        f"missing but the snapshot recorded {off} bytes and "
+                        f"no merged output {filename!r} exists; the files "
+                        "were removed outside the pipeline — clear the "
+                        "persistence storage to start fresh"
+                    )
+                size = os.path.getsize(part_path)
+                if off > size:
+                    raise RuntimeError(
+                        f"fs.write(sharded=True) restore: {part_path!r} is "
+                        f"{size} bytes but the snapshot recorded {off}; the "
+                        "part file was modified outside the pipeline — remove "
+                        "it and the persistence storage to start fresh"
+                    )
+                fh = open(part_path, "r+", newline="")
+                fh.truncate(off)
+                fh.seek(off)
+                pstate["fh"] = fh
+
         def on_done() -> None:
+            with lock:
+                if state["restored_merged"]:
+                    # previous run completed and merged; nothing new arrived
+                    # (a write would have raised in _ensure_open)
+                    state["closed"].add(w)
+                    return
+            fh = _ensure_open()  # a zero-row shard still yields a (header) part
             with lock:
                 if not fh.closed:
                     fh.flush()
+                    pstate["final_offset"] = fh.tell()
                     fh.close()
                 state["closed"].add(w)
                 # thread plane: the last shard to close merge-commits; a
@@ -370,7 +465,15 @@ def _write_sharded(table: Table, filename: str, *, format: str, **kwargs: Any) -
                     and len(state["parts"]) == state["n_workers"]
                 ):
                     _merge()
+                    state["merged_done"] = True
 
-        return ops.CallbackOutputNode(cols, on_batch, on_done, sharded=True)
+        return ops.CallbackOutputNode(
+            cols,
+            on_batch,
+            on_done,
+            sharded=True,
+            sink_state=sink_state,
+            restore_sink=restore_sink,
+        )
 
     LogicalNode(factory, [table._node], name=f"fs_write:{filename}")._register_as_output()

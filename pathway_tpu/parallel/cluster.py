@@ -27,6 +27,7 @@ from __future__ import annotations
 
 import os
 import pickle
+import select
 import socket
 import struct
 import threading
@@ -38,9 +39,11 @@ import numpy as np
 from pathway_tpu.engine.blocks import DeltaBatch
 from pathway_tpu.engine.graph import BROADCAST, END_OF_STREAM, SOLO, Node
 from pathway_tpu.internals.config import get_pathway_config
+from pathway_tpu.internals.errors import OtherWorkerError
 from pathway_tpu.internals.logical import BuildContext, LogicalNode
 from pathway_tpu.internals.trace import run_annotated
 from pathway_tpu.parallel.mesh import shard_of_keys
+from pathway_tpu.resilience import faults as _faults
 
 
 def cluster_env() -> tuple[int, int, int, int]:
@@ -201,53 +204,130 @@ class _PeerLinks:
                 pass
 
 
+#: select() granularity while waiting on a barrier — how often the failure
+#: detector is consulted, NOT an added latency (a ready socket returns at once)
+_BARRIER_POLL_S = 0.2
+
+
 class _Coordinator:
     """Process 0's barrier service: collects per-round reports, answers
-    continue/advance/close decisions to every process (including itself)."""
+    continue/advance/close decisions to every process (including itself).
 
-    def __init__(self, n_proc: int, first_port: int, host: str = "127.0.0.1"):
+    Peers identify themselves with a ``("join", pid)`` handshake, so a dead
+    barrier connection maps to a process id. While waiting for reports the
+    coordinator polls the heartbeat monitor (``resilience/heartbeat.py``):
+    a peer that died (socket EOF) or went silent past ``heartbeat_timeout``
+    surfaces as a structured ``OtherWorkerError`` naming the process and its
+    last-known tick — broadcast to the surviving peers before raising, so the
+    whole cluster fails with the same diagnosis instead of a cascade of bare
+    timeouts (the reference's worker-panic propagation, SURVEY §5.3)."""
+
+    def __init__(
+        self, n_proc: int, first_port: int, host: str = "127.0.0.1", monitor: Any = None
+    ):
         self.n_proc = n_proc
+        self.monitor = monitor
         self._server = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._server.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._server.bind((host, first_port))
         self._server.listen(n_proc)
-        self._conns: list[socket.socket] = []
+        self._conns: dict[int, socket.socket] = {}
 
     def wait_connections(self) -> None:
-        self._server.settimeout(barrier_timeout())
+        deadline = _time.monotonic() + barrier_timeout()
+        self._server.settimeout(_BARRIER_POLL_S)
         while len(self._conns) < self.n_proc - 1:
             try:
                 conn, _ = self._server.accept()
             except socket.timeout:
-                raise RuntimeError(
-                    f"cluster startup timed out: {len(self._conns) + 1}/{self.n_proc} "
-                    "processes joined"
-                ) from None
-            self._conns.append(conn)
+                if _time.monotonic() > deadline:
+                    missing = sorted(set(range(1, self.n_proc)) - set(self._conns))
+                    raise OtherWorkerError(
+                        f"cluster startup timed out: process(es) {missing} never "
+                        f"joined ({len(self._conns) + 1}/{self.n_proc} up)",
+                        process_id=missing[0] if missing else None,
+                        reason="never-joined",
+                    ) from None
+                continue
+            conn.settimeout(barrier_timeout())
+            msg = _recv_msg(conn)
+            if not (isinstance(msg, tuple) and len(msg) == 2 and msg[0] == "join"):
+                raise RuntimeError(f"unexpected cluster join message {msg!r}")
+            self._conns[int(msg[1])] = conn
+
+    def _peer_failed(self, pid: int | None, tick: int | None, reason: str) -> None:
+        """Broadcast the failure diagnosis to survivors, then raise."""
+        fail = {"__fail__": {"process_id": pid, "tick": tick, "reason": reason}}
+        for conn in self._conns.values():
+            try:
+                _send_msg(conn, fail)
+            except OSError:
+                pass
+        at = f" (last alive at tick {tick})" if tick is not None else ""
+        raise OtherWorkerError(
+            f"cluster process {pid} failed: {reason}{at}",
+            process_id=pid,
+            tick=tick,
+            reason=reason,
+        )
+
+    def _check_detector(self) -> None:
+        if self.monitor is None:
+            return
+        dead = self.monitor.dead_peer()
+        if dead is not None:
+            pid, tick, reason = dead
+            self._peer_failed(pid, tick, reason)
+
+    def _recv_report(self, pid: int, conn: socket.socket, deadline: float) -> Any:
+        while True:
+            self._check_detector()
+            try:
+                readable, _, _ = select.select([conn], [], [], _BARRIER_POLL_S)
+            except OSError:
+                self._peer_failed(pid, self._last_tick(pid), "disconnected")
+            if readable:
+                break
+            if _time.monotonic() > deadline:
+                self._peer_failed(pid, self._last_tick(pid), "barrier-timeout")
+        # readable: the full frame follows promptly (the sender uses sendall);
+        # keep a generous timeout as a backstop against a torn write
+        conn.settimeout(max(5.0, deadline - _time.monotonic()))
+        try:
+            msg = _recv_msg(conn)
+        except socket.timeout:
+            self._peer_failed(pid, None, "barrier-timeout")
+        except OSError:
+            # a SIGKILLed peer with unread data queued sends RST — a reset is
+            # the same diagnosis as clean EOF: the peer is gone
+            self._peer_failed(pid, self._last_tick(pid), "disconnected")
+        if msg is None:
+            self._peer_failed(pid, self._last_tick(pid), "disconnected")
+        return msg
+
+    def _last_tick(self, pid: int) -> int | None:
+        return self.monitor.seen_peers().get(pid) if self.monitor else None
 
     def barrier(self, my_report: Any, decide) -> Any:
         """Collect one report from every peer + self, apply ``decide`` over the
         list, broadcast and return the decision."""
         reports = [my_report]
-        timeout = barrier_timeout()
-        for conn in self._conns:
-            conn.settimeout(timeout)
-            try:
-                msg = _recv_msg(conn)
-            except socket.timeout:
-                raise RuntimeError(
-                    f"cluster barrier timed out after {timeout}s waiting for a peer"
-                ) from None
-            if msg is None:
-                raise RuntimeError("cluster peer disconnected")
-            reports.append(msg)
+        deadline = _time.monotonic() + barrier_timeout()
+        for pid, conn in self._conns.items():
+            reports.append(self._recv_report(pid, conn, deadline))
         decision = decide(reports)
-        for conn in self._conns:
-            _send_msg(conn, decision)
+        for pid, conn in self._conns.items():
+            try:
+                _send_msg(conn, decision)
+            except OSError:
+                # the peer died after reporting: surface the structured
+                # diagnosis (and tell the other survivors) instead of dying
+                # on a bare broken pipe
+                self._peer_failed(pid, self._last_tick(pid), "disconnected")
         return decision
 
     def close(self) -> None:
-        for c in self._conns:
+        for c in self._conns.values():
             try:
                 c.close()
             except OSError:
@@ -259,7 +339,11 @@ class _Coordinator:
 
 
 class _CoordinatorClient:
-    def __init__(self, first_port: int, host: str = "127.0.0.1"):
+    def __init__(
+        self, pid: int, first_port: int, host: str = "127.0.0.1", hb_client: Any = None
+    ):
+        self.pid = pid
+        self.hb = hb_client  # HeartbeatClient: flags a vanished coordinator
         deadline = _time.time() + 30
         while True:
             try:
@@ -270,18 +354,50 @@ class _CoordinatorClient:
                     raise
                 _time.sleep(0.05)
         self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        _send_msg(self._sock, ("join", pid))
+
+    def _coordinator_lost(self, reason: str) -> None:
+        raise OtherWorkerError(
+            f"cluster coordinator (process 0) lost: {reason}",
+            process_id=0,
+            reason=reason,
+        )
 
     def barrier(self, my_report: Any, decide=None) -> Any:
-        _send_msg(self._sock, my_report)
-        self._sock.settimeout(barrier_timeout())
+        try:
+            _send_msg(self._sock, my_report)
+        except OSError:
+            self._coordinator_lost("disconnected")
+        deadline = _time.monotonic() + barrier_timeout()
+        while True:
+            if self.hb is not None and self.hb.coordinator_lost:
+                self._coordinator_lost("coordinator-lost")
+            try:
+                readable, _, _ = select.select([self._sock], [], [], _BARRIER_POLL_S)
+            except OSError:
+                self._coordinator_lost("disconnected")
+            if readable:
+                break
+            if _time.monotonic() > deadline:
+                self._coordinator_lost("barrier-timeout")
+        self._sock.settimeout(max(5.0, deadline - _time.monotonic()))
         try:
             decision = _recv_msg(self._sock)
         except socket.timeout:
-            raise RuntimeError(
-                "cluster barrier timed out waiting for the coordinator"
-            ) from None
+            self._coordinator_lost("barrier-timeout")
+        except OSError:
+            self._coordinator_lost("disconnected")  # RST counts as gone
         if decision is None:
-            raise RuntimeError("cluster coordinator disconnected")
+            self._coordinator_lost("disconnected")
+        if isinstance(decision, dict) and "__fail__" in decision:
+            f = decision["__fail__"]
+            at = f" (last alive at tick {f['tick']})" if f["tick"] is not None else ""
+            raise OtherWorkerError(
+                f"cluster process {f['process_id']} failed: {f['reason']}{at}",
+                process_id=f["process_id"],
+                tick=f["tick"],
+                reason=f["reason"],
+            )
         return decision
 
     def close(self) -> None:
@@ -333,8 +449,29 @@ class ClusterRuntime:
 
         self.device_plane = make_cluster_device_plane(self.n_workers, threads, pid)
         self.links = _PeerLinks(pid, processes, first_port, self._on_remote_block)
+        # failure detection (resilience subsystem): a dedicated heartbeat link
+        # per peer on port first_port + processes + 1, so the cluster occupies
+        # ports [first_port, first_port + processes + 1]
+        cfg = get_pathway_config()
+        self.hb_monitor = None
+        self.hb_client = None
+        if processes > 1 and cfg.heartbeat_interval > 0:
+            from pathway_tpu.resilience.heartbeat import (
+                HeartbeatClient,
+                HeartbeatMonitor,
+            )
+
+            hb_port = first_port + processes + 1
+            if pid == 0:
+                self.hb_monitor = HeartbeatMonitor(
+                    processes, hb_port, timeout=cfg.heartbeat_timeout
+                )
+            else:
+                self.hb_client = HeartbeatClient(
+                    pid, hb_port, cfg.heartbeat_interval
+                )
         if pid == 0:
-            self.coord = _Coordinator(processes, first_port)
+            self.coord = _Coordinator(processes, first_port, monitor=self.hb_monitor)
         else:
             self.coord = None
         self.client = None  # set in run()
@@ -459,6 +596,7 @@ class ClusterRuntime:
             did_any = True
 
     def _barrier(self, report: Any, decide) -> Any:
+        _faults.before_barrier(self.pid, self.current_time)
         if self.pid == 0:
             return self.coord.barrier(report, decide)
         return self.client.barrier(report)
@@ -533,21 +671,25 @@ class ClusterRuntime:
                     if node._shared.tick_max is None or tm > node._shared.tick_max:
                         node._shared.tick_max = tm
 
-    def run_tick(self, time: int) -> None:
+    def run_tick(self, time: int, skip_poll: bool = False) -> None:
         self.current_time = time
+        if self.hb_client is not None:
+            self.hb_client.tick = time
         # non-partitioned sources poll on global worker 0 only; partitioned
         # sources (local_source, r5) poll on every owning worker — including
-        # workers hosted by peer processes
-        if 0 in self.local_workers:
+        # workers hosted by peer processes. ``skip_poll`` is the drop_poll
+        # fault-injection point: buffered events stay upstream for this tick.
+        if not skip_poll and 0 in self.local_workers:
             lw0 = self.local_workers[0]
             for node in lw0.graph.nodes:
                 self._route(lw0, node, run_annotated(node, node.poll, time))
-        for gi, lw in self.local_workers.items():
-            if gi == 0:
-                continue
-            for node in lw.graph.nodes:
-                if getattr(node, "local_source", False):
-                    self._route(lw, node, run_annotated(node, node.poll, time))
+        if not skip_poll:
+            for gi, lw in self.local_workers.items():
+                if gi == 0:
+                    continue
+                for node in lw.graph.nodes:
+                    if getattr(node, "local_source", False):
+                        self._route(lw, node, run_annotated(node, node.poll, time))
         self._round_until_quiescent(time, "sweep")
         while True:
             self._sync_watermarks()
@@ -572,19 +714,22 @@ class ClusterRuntime:
 
     # ---------------------------------------------------------------- run loop
     def run(self, outputs: list[LogicalNode]):
+        _faults.install_from_env()
         self._build(outputs)
         self.streaming = bool(self.connectors)
         if self.pid == 0:
             self.coord.wait_connections()
         else:
-            self.client = _CoordinatorClient(self.first_port)
-        if self.persistence is not None and (
-            self.pid == 0 or getattr(self.persistence, "operator_mode", False)
-        ):
-            # input snapshots live with the sources on process 0; operator
-            # mode additionally snapshots/restores every process's own worker
-            # shards (barrier-coordinated, see snapshots.py), so its hooks run
-            # on ALL processes
+            self.client = _CoordinatorClient(
+                self.pid, self.first_port, hb_client=self.hb_client
+            )
+        if self.persistence is not None:
+            # every process participates: input snapshots live with the
+            # sources on process 0, peers persist their own partitioned source
+            # slices, operator mode additionally snapshots/restores every
+            # process's worker shards, and the per-tick epoch barrier commits
+            # a global manifest (barrier-coordinated, see snapshots.py) — so
+            # the hooks must run in lockstep on ALL processes
             self.persistence.on_graph_built(getattr(self, "_ctx0", self._ctx_local))
             self.on_tick_done.append(self.persistence.on_tick_done)
         # every process starts ITS OWN connectors: process 0 owns the
@@ -597,7 +742,8 @@ class ClusterRuntime:
         try:
             while True:
                 t0 = _time.perf_counter()
-                self.run_tick(tick)
+                drop_poll = _faults.on_tick_start(self.pid, tick)
+                self.run_tick(tick, skip_poll=drop_poll)
                 tick += 1
                 from pathway_tpu.engine.runtime import check_connector_failures
 
@@ -646,10 +792,14 @@ class ClusterRuntime:
         for lw in self.local_workers.values():
             for node in lw.graph.nodes:
                 node.on_end()
-        if self.persistence is not None and (
-            self.pid == 0 or getattr(self.persistence, "operator_mode", False)
-        ):
+        if self.persistence is not None:
             self.persistence.on_close()
+        # heartbeats outlive the last persistence barrier (a peer dying inside
+        # on_close must still be detected); the goodbye marks this exit clean
+        if self.hb_client is not None:
+            self.hb_client.goodbye()
+        if self.hb_monitor is not None:
+            self.hb_monitor.close()
         if self.client is not None:
             self.client.close()
         if self.coord is not None:

@@ -56,3 +56,12 @@ def attach_persistence(runtime: Any, config: Config) -> None:
     from pathway_tpu.persistence.snapshots import attach
 
     attach(runtime, config)
+
+
+def last_committed_epoch(backend_or_config: Any) -> Any:
+    """Newest fully-committed checkpoint epoch (``resilience`` subsystem):
+    ``{"epoch", "tick", "input_offsets", "opsnap_gen", "acks", …}`` or None.
+    Accepts a ``Backend``, ``Config``, or raw ``KVBackend``."""
+    from pathway_tpu.persistence.snapshots import read_epoch_manifest
+
+    return read_epoch_manifest(backend_or_config)

@@ -47,6 +47,12 @@ class FileBackend(KVBackend):
         with self._lock:
             with open(tmp, "wb") as f:
                 f.write(value)
+                # the epoch/manifest commit protocol treats a completed put as
+                # DURABLE (a barrier ack may immediately follow); fsync before
+                # the atomic publish so a host crash can't leave a committed
+                # manifest pointing at torn state
+                f.flush()
+                os.fsync(f.fileno())
             os.replace(tmp, path)  # atomic publish
 
     def get(self, key: str) -> bytes | None:

@@ -40,6 +40,7 @@ from pathway_tpu.persistence.backends import KVBackend, backend_from_config
 _CHUNK = "chunk"
 _META = "metadata"
 _MANIFEST = "operators/manifest"
+_EPOCH_MANIFEST = "epochs/manifest"
 
 
 class _PersistedInput:
@@ -121,11 +122,14 @@ class _PersistedInput:
             ),
         )
 
-    def replay(self) -> None:
+    def replay(self) -> int:
         """Push the stored event log into the node (before live reads start) —
         through the ORIGINAL push so replay isn't counted as live traffic.
-        With an operator snapshot, only the suffix past ``replay_skip`` runs."""
+        With an operator snapshot, only the suffix past ``replay_skip`` runs.
+        Returns the number of events actually replayed (the O(suffix) part of
+        recovery — the resilience telemetry and tests assert on it)."""
         to_skip = self.replay_skip - self.trimmed_events
+        replayed = 0
         for i in range(self.first_chunk, self.n_chunks):
             raw = self.backend.get(self._key(f"{_CHUNK}_{i:08d}"))
             if raw is None:
@@ -141,7 +145,9 @@ class _PersistedInput:
                 continue
             for key, values, diff in events[to_skip:]:
                 self._original_push(key, values, diff)
+            replayed += len(events) - to_skip
             to_skip = 0
+        return replayed
 
     def flush(self) -> None:
         # for seekable sources, buffer capture + reader-state read happen under
@@ -331,6 +337,74 @@ class _OperatorSnapshots:
         self.advance()
 
 
+class _EpochLog:
+    """Global checkpoint-epoch manifest (resilience subsystem).
+
+    The reference's restart point is the min-over-workers finalized time
+    (``src/persistence/state.rs:291``); here it is the newest FULLY-committed
+    epoch: a record process 0 publishes only after every process has reported
+    its input-log flushes (and, in operator mode, its state shards) durable.
+    Supervisors (``resilience.Supervisor``) and operators read it through
+    ``resilience.last_committed_epoch`` to know where a relaunch resumes."""
+
+    def __init__(self, backend: KVBackend):
+        self.backend = backend
+        prev = read_epoch_manifest(backend)
+        self.epoch = prev["epoch"] if prev else -1
+        self._last_offsets: dict | None = prev["input_offsets"] if prev else None
+
+    def commit(
+        self,
+        tick: int,
+        offsets: dict[str, int],
+        *,
+        opsnap_gen: int | None = None,
+        acks: list[int] | None = None,
+        force: bool = False,
+    ) -> bool:
+        """Publish a new epoch when the durable input frontier moved (or a new
+        operator generation committed). Returns True when an epoch was written."""
+        if not force and offsets == self._last_offsets and opsnap_gen is None:
+            return False
+        self.epoch += 1
+        self._last_offsets = dict(offsets)
+        self.backend.put(
+            _EPOCH_MANIFEST,
+            pickle.dumps(
+                {
+                    "epoch": self.epoch,
+                    "tick": tick,
+                    "input_offsets": dict(offsets),
+                    "opsnap_gen": opsnap_gen,
+                    "acks": sorted(acks) if acks is not None else [0],
+                    "committed_unix": _time.time(),
+                }
+            ),
+        )
+        from pathway_tpu.internals.telemetry import record_event
+
+        record_event(
+            "resilience.epoch_committed",
+            epoch=self.epoch,
+            tick=tick,
+            opsnap_gen=opsnap_gen if opsnap_gen is not None else -1,
+            n_inputs=len(offsets),
+        )
+        return True
+
+
+def read_epoch_manifest(backend_or_config) -> dict | None:
+    """Newest fully-committed epoch record, or None. Accepts a raw KVBackend,
+    a ``persistence.Backend``, or a ``persistence.Config``."""
+    backend = backend_or_config
+    if hasattr(backend, "backend") and not isinstance(backend, KVBackend):
+        backend = backend.backend  # Config → Backend
+    if not isinstance(backend, KVBackend):
+        backend = backend_from_config(backend)  # Backend → KVBackend
+    raw = backend.get(_EPOCH_MANIFEST)
+    return pickle.loads(raw) if raw is not None else None
+
+
 class Persistence:
     def __init__(self, config, runtime=None):
         self.config = config
@@ -339,6 +413,8 @@ class Persistence:
         self.operator_mode = config.persistence_mode == "operator_persisting"
         self.inputs: list[_PersistedInput] = []
         self.opsnap: _OperatorSnapshots | None = None
+        self.epochs: _EpochLog | None = None
+        self.replayed_events = 0
         self._worker_nodes: dict[int, list] = {}
         self._node_names: list = []
         self._is_cluster = False
@@ -348,20 +424,30 @@ class Persistence:
     # called by Runtime once the engine graph is built, before drivers start
     def on_graph_built(self, ctx) -> None:
         offsets: dict[str, int] = {}
+        # cluster detection happens for EVERY persistence mode (not just
+        # operator persisting): peers must take the partitioned-peer path
+        # below or they would clobber process 0's input logs in the shared
+        # backend, and the per-tick epoch barrier needs symmetric membership
+        cluster_workers = getattr(self.runtime, "local_workers", None)
+        if cluster_workers is not None:
+            self._is_cluster = True
+            self._pid = self.runtime.pid
+            self._total_workers = self.runtime.n_workers
+        if self._pid == 0:
+            # single-writer plane: only process 0 (or the solo runtime)
+            # commits epoch manifests; peers report durability over the barrier
+            self.epochs = _EpochLog(self.backend)
         if self.operator_mode:
             # worker shards keyed by GLOBAL worker index: the single runtime is
             # {0: nodes}, the thread-sharded runtime {0..W-1}, and a cluster
             # process contributes only the workers it hosts (every process
             # snapshots/restores its own shards; process 0 commits)
-            local_workers = getattr(self.runtime, "local_workers", None)
+            local_workers = cluster_workers
             workers = getattr(self.runtime, "workers", None)
             if local_workers is not None:
-                self._is_cluster = True
-                self._pid = self.runtime.pid
                 self._worker_nodes = {
                     gi: list(lw.graph.nodes) for gi, lw in local_workers.items()
                 }
-                self._total_workers = self.runtime.n_workers
             elif workers:
                 self._worker_nodes = {w.index: list(w.graph.nodes) for w in workers}
                 self._total_workers = len(workers)
@@ -411,8 +497,7 @@ class Persistence:
             # (worker-scoped pids in the shared backend; seekable subjects
             # recover by seeking, the at-least-once OSS tier)
             self._add_partitioned_peer_inputs(offsets)
-            for p in self.inputs:
-                p.replay()
+            self._replay_all()
             return
         # pid stability: a source keeps its snapshots across unrelated pipeline
         # edits — use the connector's name alone when unique among sources, and
@@ -469,8 +554,27 @@ class Persistence:
                             replay_skip=offsets.get(pid, 0),
                         )
                     )
+        self._replay_all()
+
+    def _replay_all(self) -> None:
+        """Replay every persisted input, recording the O(suffix) cost: a run
+        recovering from operator snapshots replays only the log tail past the
+        committed offsets, and the telemetry gauge lets tests (and operators)
+        assert recovery was NOT a full-history recompute."""
+        replayed = 0
         for p in self.inputs:
-            p.replay()
+            # `or 0`: replay() wrappers in tests may not return the count
+            replayed += p.replay() or 0
+        self.replayed_events = replayed
+        if self.inputs:
+            from pathway_tpu.internals.telemetry import record_event
+
+            record_event(
+                "resilience.replay",
+                events=replayed,
+                n_inputs=len(self.inputs),
+                process_id=self._pid,
+            )
 
     @staticmethod
     def _dedup_source_pids(graph) -> dict[int, str]:
@@ -528,32 +632,82 @@ class Persistence:
     def _save_operators(self, time: int) -> None:
         assert self.opsnap is not None
         offsets = {p.pid: p.consumed() for p in self.inputs}
+        gen = self.opsnap.gen
         self.opsnap.save(self._worker_nodes, self._node_names, offsets, time)
+        if self.epochs is not None:
+            self.epochs.commit(time, offsets, opsnap_gen=gen, force=True)
         for p in self.inputs:
             p.trim(offsets[p.pid])
+
+    @staticmethod
+    def _merge_offsets(reports):
+        """Barrier decide: union the per-process {source pid → offset} maps
+        (sources are process-disjoint) and record which processes acked."""
+        merged: dict[str, int] = {}
+        acks: list[int] = []
+        for _tag, rpid, offs in reports:
+            acks.append(int(rpid))
+            merged.update(offs)
+        return {"offsets": merged, "acks": sorted(acks)}
 
     def _save_operators_cluster(self, time: int) -> None:
         """Cross-process snapshot (the reference's per-worker persist wrappers
         + finalized-time consensus, ``persist.rs:843`` / ``state.rs:291``):
         every process writes its local worker shards for the current
-        generation, a barrier proves all shards are durable, then process 0
-        alone commits the manifest — so a crash mid-save leaves the previous
-        generation authoritative on every process."""
+        generation AND reports its own input offsets over the barrier; process
+        0 commits the manifest with the MERGED offsets (so peer partitions
+        recover O(suffix) too) plus the global epoch record, a second barrier
+        proves the commit durable, then every process compacts its own logs.
+        A crash mid-save leaves the previous generation authoritative on every
+        process; a crash between commit and trim only delays GC."""
         assert self.opsnap is not None
         self.opsnap.save_shards(self._worker_nodes)
-        self.runtime._barrier(("persist_done", True), lambda reports: {"ok": True})
+        local_offsets = {p.pid: p.consumed() for p in self.inputs}
+        decision = self.runtime._barrier(
+            ("persist_done", self._pid, local_offsets), self._merge_offsets
+        )
         if self._pid == 0:
-            offsets = {p.pid: p.consumed() for p in self.inputs}
+            gen = self.opsnap.gen
             self.opsnap.commit(
-                self._node_names, offsets, time, self._total_workers
+                self._node_names, decision["offsets"], time, self._total_workers
             )
-            for p in self.inputs:
-                p.trim(offsets[p.pid])
+            if self.epochs is not None:
+                self.epochs.commit(
+                    time,
+                    decision["offsets"],
+                    opsnap_gen=gen,
+                    acks=decision["acks"],
+                    force=True,
+                )
+        # trim only after the commit is proven durable everywhere — trimming
+        # against an uncommitted generation could orphan replay history
+        self.runtime._barrier(
+            ("commit_done", self._pid, {}), lambda reports: {"ok": True}
+        )
+        for p in self.inputs:
+            p.trim(decision["offsets"].get(p.pid, 0))
         self.opsnap.advance()
+
+    def _commit_epoch(self, time: int) -> None:
+        """Input-frontier epochs: after this tick's flushes, publish a global
+        epoch manifest of the durable per-source offsets. In cluster mode a
+        barrier first collects every process's flushed offsets — the commit
+        is by construction 'all processes reported durable'."""
+        if not self._is_cluster:
+            if self.epochs is not None:
+                self.epochs.commit(time, {p.pid: p.persisted for p in self.inputs})
+            return
+        local = {p.pid: p.persisted for p in self.inputs}
+        decision = self.runtime._barrier(
+            ("epoch", self._pid, local), self._merge_offsets
+        )
+        if self.epochs is not None:  # process 0 is the single epoch writer
+            self.epochs.commit(time, decision["offsets"], acks=decision["acks"])
 
     def on_tick_done(self, time: int) -> None:
         for p in self.inputs:
             p.flush()
+        self._commit_epoch(time)
         if not self.operator_mode or self.opsnap is None:
             return
         if not self._is_cluster:
@@ -577,12 +731,13 @@ class Persistence:
         for p in self.inputs:
             p.flush()
         if not self.operator_mode or self.opsnap is None:
+            self._commit_epoch(-1)
             return
         if not self._is_cluster:
             self._save_operators(-1)
             return
         # forced final snapshot: every operator-mode process reaches on_close
-        # in lockstep and _save_operators_cluster carries its own barrier
+        # in lockstep and _save_operators_cluster carries its own barriers
         self._save_operators_cluster(-1)
 
 

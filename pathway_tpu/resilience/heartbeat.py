@@ -1,0 +1,246 @@
+"""Cluster failure detection: heartbeats on the control plane.
+
+The cluster's barrier protocol (``parallel/cluster.py``) only learns about a
+dead peer when a barrier read times out after the full ``barrier_timeout`` —
+and then it can't say WHICH peer died. This module adds the reference's
+worker-liveness signal (SURVEY §5.3: a dead worker surfaces as
+``OtherWorkerError`` naming the failure) as a dedicated heartbeat link per
+peer:
+
+- every non-coordinator process runs a :class:`HeartbeatClient` — a daemon
+  thread holding one TCP connection to process 0 and sending
+  ``("hb", pid, tick)`` every ``heartbeat_interval`` seconds;
+- process 0 runs the :class:`HeartbeatMonitor` — it tracks per-peer last-seen
+  times and last-known ticks, and answers :meth:`HeartbeatMonitor.dead_peer`
+  for the barrier loops.
+
+Detection is two-tier: a peer whose PROCESS dies (SIGKILL, OOM, crash) closes
+its socket, so the monitor sees EOF within milliseconds; a peer that is alive
+but wedged trips the ``heartbeat_timeout`` miss threshold. Either way the
+barrier raises a structured ``OtherWorkerError(process_id=…, tick=…)`` instead
+of a bare timeout. Clean shutdown sends ``("bye", pid)`` first so normal exits
+never read as failures.
+"""
+
+from __future__ import annotations
+
+import pickle
+import socket
+import struct
+import threading
+import time as _time
+
+from pathway_tpu.internals.telemetry import record_event
+
+
+# same length-prefixed-pickle framing as the cluster plane (cluster.py), kept
+# local so the detector has no import-order coupling with the runtime it guards
+def _send(sock: socket.socket, obj) -> None:
+    payload = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+    sock.sendall(struct.pack("<Q", len(payload)) + payload)
+
+
+def _recv(sock: socket.socket):
+    buf = b""
+    while len(buf) < 8:
+        chunk = sock.recv(8 - len(buf))
+        if not chunk:
+            return None
+        buf += chunk
+    (n,) = struct.unpack("<Q", buf)
+    payload = b""
+    while len(payload) < n:
+        chunk = sock.recv(n - len(payload))
+        if not chunk:
+            return None
+        payload += chunk
+    return pickle.loads(payload)
+
+
+class _PeerState:
+    __slots__ = ("last_seen", "tick", "eof", "clean")
+
+    def __init__(self) -> None:
+        self.last_seen = _time.monotonic()
+        self.tick: int | None = None
+        self.eof = False
+        self.clean = False
+
+
+class HeartbeatMonitor:
+    """Process 0's failure detector: accepts one heartbeat connection per peer."""
+
+    def __init__(
+        self,
+        n_proc: int,
+        port: int,
+        host: str = "127.0.0.1",
+        timeout: float = 10.0,
+    ):
+        self.n_proc = n_proc
+        self.timeout = timeout
+        self._lock = threading.Lock()
+        self._peers: dict[int, _PeerState] = {}
+        self._reported: set[int] = set()
+        self._conns: list[socket.socket] = []
+        self._closed = False
+        self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._listener.bind((host, port))
+        self._listener.listen(n_proc)
+        self.port = self._listener.getsockname()[1]
+        threading.Thread(target=self._accept_loop, daemon=True).start()
+
+    def _accept_loop(self) -> None:
+        while not self._closed:
+            try:
+                conn, _ = self._listener.accept()
+            except OSError:
+                return
+            self._conns.append(conn)
+            threading.Thread(target=self._recv_loop, args=(conn,), daemon=True).start()
+
+    def _recv_loop(self, conn: socket.socket) -> None:
+        pid: int | None = None
+        try:
+            while True:
+                msg = _recv(conn)
+                if msg is None:
+                    break  # EOF
+                kind, peer, tick = msg
+                if pid is None:
+                    pid = int(peer)
+                    with self._lock:
+                        self._peers.setdefault(pid, _PeerState())
+                with self._lock:
+                    st = self._peers[pid]
+                    st.last_seen = _time.monotonic()
+                    if tick is not None:
+                        st.tick = int(tick)
+                    if kind == "bye":
+                        st.clean = True
+                if kind == "bye":
+                    break
+        except Exception:
+            pass  # a torn message counts as EOF below
+        finally:
+            if pid is not None:
+                with self._lock:
+                    st = self._peers.get(pid)
+                    if st is not None and not st.clean:
+                        st.eof = True
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def seen_peers(self) -> dict[int, int | None]:
+        """pid → last-known tick, for every peer that ever connected."""
+        with self._lock:
+            return {pid: st.tick for pid, st in self._peers.items()}
+
+    def dead_peer(self) -> tuple[int, int | None, str] | None:
+        """(pid, last_tick, reason) of a failed peer, else None. EOF beats a
+        heartbeat miss (it is definitive); each miss is recorded once."""
+        if self._closed:
+            return None
+        now = _time.monotonic()
+        with self._lock:
+            for pid, st in self._peers.items():
+                if st.clean:
+                    continue
+                if st.eof:
+                    return pid, st.tick, "disconnected"
+            for pid, st in self._peers.items():
+                if st.clean or st.eof:
+                    continue
+                if now - st.last_seen > self.timeout:
+                    if pid not in self._reported:
+                        self._reported.add(pid)
+                        record_event(
+                            "resilience.heartbeat_miss",
+                            process_id=pid,
+                            tick=st.tick if st.tick is not None else -1,
+                            silent_s=round(now - st.last_seen, 3),
+                        )
+                    return pid, st.tick, "heartbeat-timeout"
+        return None
+
+    def close(self) -> None:
+        self._closed = True
+        try:
+            self._listener.close()
+        except OSError:
+            pass
+        for conn in self._conns:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+
+class HeartbeatClient:
+    """A peer's side: one connection + a daemon sender thread. The runtime
+    bumps ``self.tick`` each tick; ``coordinator_lost`` flips when sends start
+    failing after a successful connect (process 0 is gone)."""
+
+    def __init__(
+        self,
+        pid: int,
+        port: int,
+        interval: float,
+        host: str = "127.0.0.1",
+        connect_timeout: float = 30.0,
+    ):
+        self.pid = pid
+        self.interval = interval
+        self.tick = 0
+        self.coordinator_lost = False
+        self._closed = False
+        self._sock: socket.socket | None = None
+        self._host = host
+        self._port = port
+        self._connect_timeout = connect_timeout
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
+    def _loop(self) -> None:
+        deadline = _time.monotonic() + self._connect_timeout
+        while not self._closed:
+            try:
+                self._sock = socket.create_connection(
+                    (self._host, self._port), timeout=5
+                )
+                break
+            except OSError:
+                if _time.monotonic() > deadline:
+                    return  # no monitor (e.g. heartbeats disabled on pid 0)
+                _time.sleep(0.05)
+        while not self._closed:
+            try:
+                _send(self._sock, ("hb", self.pid, self.tick))
+            except OSError:
+                if not self._closed:
+                    self.coordinator_lost = True
+                    record_event(
+                        "resilience.heartbeat_miss",
+                        process_id=0,
+                        tick=self.tick,
+                        silent_s=0.0,
+                    )
+                return
+            _time.sleep(self.interval)
+
+    def goodbye(self) -> None:
+        """Clean shutdown: tell the monitor this exit is intentional."""
+        self._closed = True
+        sock = self._sock
+        if sock is not None:
+            try:
+                _send(sock, ("bye", self.pid, self.tick))
+            except OSError:
+                pass
+            try:
+                sock.close()
+            except OSError:
+                pass

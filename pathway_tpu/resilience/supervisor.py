@@ -1,0 +1,252 @@
+"""Supervised cluster restart: run the pipeline as child processes, relaunch
+on failure from the last committed checkpoint epoch.
+
+The reference's recovery story (SURVEY §5.3–5.4) is "worker dies → restart the
+cluster → persistence replays to the last finalized time". The
+:class:`Supervisor` is that restart loop as a first-class object: it owns the
+child processes (one per ``PATHWAY_PROCESS_ID``, the same env contract as
+``python -m pathway_tpu spawn``), detects any child failing (non-zero exit or
+death by signal), tears the survivors down, waits an exponential backoff, and
+relaunches the whole cluster. Recovery state lives entirely in the persistence
+backend — a relaunched cluster finds the newest fully-committed epoch
+(``persistence/snapshots.py`` epoch manifest) and resumes from it, so the
+supervisor itself is stateless across its own restarts.
+
+Fault injection composes: set ``PATHWAY_FAULT_PLAN`` (see ``faults.py``) in the
+supervisor env and the injected kill exercises exactly this path. By default
+the plan is dropped from child envs after the first failure so a "kill at tick
+N" fault doesn't re-fire forever on every relaunch.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+import time as _time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Sequence
+
+from pathway_tpu.internals.config import get_pathway_config
+from pathway_tpu.internals.telemetry import record_event
+
+
+class SupervisorGaveUp(RuntimeError):
+    """The restart budget is exhausted; ``attempts`` holds per-attempt info."""
+
+    def __init__(self, message: str, attempts: list[dict]):
+        super().__init__(message)
+        self.attempts = attempts
+
+
+@dataclass
+class SupervisorResult:
+    restarts: int
+    attempts: list[dict] = field(default_factory=list)
+    log_paths: list[str] = field(default_factory=list)
+
+
+class Supervisor:
+    """Run ``program`` as a ``processes``-wide cluster with bounded restarts.
+
+    Parameters mirror the CLI spawn contract; unset values come from the
+    ``PATHWAY_*`` environment (``PathwayConfig``). ``log_dir`` captures each
+    child's combined stdout/stderr to ``attempt<k>-p<pid>.log`` (otherwise
+    children inherit the supervisor's streams). ``on_restart(attempt, codes)``
+    runs after a failed attempt is torn down and before the backoff sleep —
+    tests use it to snapshot output files at the crash point.
+    """
+
+    def __init__(
+        self,
+        program: Sequence[str],
+        *,
+        processes: int | None = None,
+        threads: int | None = None,
+        first_port: int | None = None,
+        max_restarts: int | None = None,
+        backoff_s: float | None = None,
+        backoff_max_s: float = 30.0,
+        env: dict[str, str] | None = None,
+        log_dir: str | None = None,
+        clear_fault_plan_after_failure: bool = True,
+        poll_interval: float = 0.05,
+        term_grace_s: float = 5.0,
+        on_restart: Callable[[int, list[int | None]], Any] | None = None,
+    ):
+        cfg = get_pathway_config()
+        self.program = list(program)
+        if not self.program:
+            raise ValueError("Supervisor needs a program argv")
+        self.processes = processes if processes is not None else cfg.processes
+        self.threads = threads if threads is not None else cfg.threads
+        self.first_port = first_port if first_port is not None else cfg.first_port
+        self.max_restarts = (
+            max_restarts if max_restarts is not None else cfg.supervisor_max_restarts
+        )
+        self.backoff_s = backoff_s if backoff_s is not None else cfg.supervisor_backoff_s
+        self.backoff_max_s = backoff_max_s
+        self.env = dict(env) if env is not None else dict(os.environ)
+        self.log_dir = log_dir
+        self.clear_fault_plan_after_failure = clear_fault_plan_after_failure
+        self.poll_interval = poll_interval
+        self.term_grace_s = term_grace_s
+        self.on_restart = on_restart
+        self.restarts = 0
+        self.attempts: list[dict] = []
+
+    # -- internals ------------------------------------------------------------
+    def _child_env(self, pid: int, attempt: int) -> dict[str, str]:
+        env = dict(self.env)
+        env["PATHWAY_THREADS"] = str(self.threads)
+        env["PATHWAY_PROCESSES"] = str(self.processes)
+        env["PATHWAY_PROCESS_ID"] = str(pid)
+        env["PATHWAY_FIRST_PORT"] = str(self.first_port)
+        env["PATHWAY_SUPERVISOR_ATTEMPT"] = str(attempt)
+        return env
+
+    def _launch(self, attempt: int) -> tuple[list[subprocess.Popen], list[str]]:
+        procs: list[subprocess.Popen] = []
+        logs: list[str] = []
+        for pid in range(self.processes):
+            out: Any = None
+            if self.log_dir is not None:
+                os.makedirs(self.log_dir, exist_ok=True)
+                path = os.path.join(self.log_dir, f"attempt{attempt}-p{pid}.log")
+                logs.append(path)
+                out = open(path, "w")
+            try:
+                procs.append(
+                    subprocess.Popen(
+                        self.program,
+                        env=self._child_env(pid, attempt),
+                        stdout=out,
+                        stderr=subprocess.STDOUT if out is not None else None,
+                    )
+                )
+            finally:
+                if out is not None:
+                    out.close()  # the child holds its own fd
+        return procs, logs
+
+    def _wait_attempt(
+        self, procs: list[subprocess.Popen]
+    ) -> tuple[list[int | None], list[int]]:
+        """Block until all children exit cleanly or any fails; on failure,
+        terminate the survivors (TERM, grace, KILL). Returns (final exit
+        codes, processes that failed ON THEIR OWN) — the failed set is
+        captured BEFORE the teardown, so survivors the supervisor itself
+        SIGTERMs are not misreported as the cause."""
+        while True:
+            codes = [p.poll() for p in procs]
+            failed = [i for i, c in enumerate(codes) if c not in (None, 0)]
+            if failed:
+                for p in procs:
+                    if p.poll() is None:
+                        p.terminate()
+                deadline = _time.monotonic() + self.term_grace_s
+                for p in procs:
+                    if p.poll() is None:
+                        timeout = max(0.0, deadline - _time.monotonic())
+                        try:
+                            p.wait(timeout=timeout)
+                        except subprocess.TimeoutExpired:
+                            p.kill()
+                            p.wait()
+                return [p.returncode for p in procs], failed
+            if all(c == 0 for c in codes):
+                return codes, []
+            _time.sleep(self.poll_interval)
+
+    # -- public ---------------------------------------------------------------
+    def run(self) -> SupervisorResult:
+        all_logs: list[str] = []
+        attempt = 0
+        while True:
+            t0_ns = _time.time_ns()
+            procs, logs = self._launch(attempt)
+            all_logs.extend(logs)
+            codes, failed = self._wait_attempt(procs)
+            info = {
+                "attempt": attempt,
+                "exit_codes": codes,
+                "failed_processes": failed,
+                "start_ns": t0_ns,
+                "end_ns": _time.time_ns(),
+            }
+            self.attempts.append(info)
+            if not failed:
+                self._export_trace()
+                return SupervisorResult(
+                    restarts=self.restarts, attempts=self.attempts, log_paths=all_logs
+                )
+            record_event(
+                "resilience.restart",
+                attempt=attempt,
+                failed_process=failed[0],
+                exit_code=int(codes[failed[0]] or 0),
+                restarts_so_far=self.restarts,
+            )
+            if attempt >= self.max_restarts:
+                self._export_trace()
+                raise SupervisorGaveUp(
+                    f"cluster failed {attempt + 1} time(s) "
+                    f"(processes {failed} exited {[codes[i] for i in failed]}); "
+                    f"restart budget of {self.max_restarts} exhausted",
+                    self.attempts,
+                )
+            if self.clear_fault_plan_after_failure:
+                self.env.pop("PATHWAY_FAULT_PLAN", None)
+            if self.on_restart is not None:
+                self.on_restart(attempt, codes)
+            delay = min(self.backoff_s * (2**attempt), self.backoff_max_s)
+            if delay > 0:
+                _time.sleep(delay)
+            self.restarts += 1
+            attempt += 1
+
+    def _export_trace(self) -> None:
+        """One span per attempt in an OTLP/JSON doc next to the run traces."""
+        from pathway_tpu.internals import telemetry as _telemetry
+
+        path = _telemetry.trace_file()
+        if not path or not self.attempts:
+            return
+        try:
+            spans = [
+                (
+                    "supervisor.attempt",
+                    a["start_ns"],
+                    a["end_ns"],
+                    {
+                        "pathway.supervisor.attempt": a["attempt"],
+                        "pathway.supervisor.failed": bool(a["failed_processes"]),
+                        "pathway.supervisor.exit_codes": str(a["exit_codes"]),
+                    },
+                )
+                for a in self.attempts
+            ]
+            _telemetry.export_spans(
+                f"{path}.supervisor", spans, root_name="pathway.supervise"
+            )
+        except Exception:
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "supervisor trace export failed", exc_info=True
+            )
+
+
+def supervise(program: Sequence[str], **kwargs: Any) -> SupervisorResult:
+    """Convenience wrapper: ``resilience.supervise([sys.executable, "p.py"])``."""
+    return Supervisor(program, **kwargs).run()
+
+
+def main(argv: Sequence[str] | None = None) -> int:  # pragma: no cover - CLI glue
+    argv = list(sys.argv[1:] if argv is None else argv)
+    try:
+        Supervisor(argv).run()
+        return 0
+    except SupervisorGaveUp as e:
+        print(f"pathway_tpu supervisor: {e}", file=sys.stderr)
+        return 1

@@ -141,6 +141,90 @@ def test_airbyte_streaming_incremental_state(connector):
     assert names == ["u0", "u1", "u2", "u3"], names  # no duplicates: state resumed
 
 
+def test_airbyte_per_stream_state_merges_across_streams():
+    """ADVICE r5 / ISSUE 2 satellite: STREAM-typed STATE messages are kept per
+    stream descriptor and the MERGED document hands back on the next read —
+    with two incremental streams, neither re-syncs from scratch (the old code
+    kept only the last STATE, losing the other stream's cursor)."""
+
+    def stream_state(name, cursor):
+        return {
+            "type": "STREAM",
+            "stream": {
+                "stream_descriptor": {"name": name},
+                "stream_state": {"cursor": cursor},
+            },
+        }
+
+    class R:
+        def __init__(self):
+            self.states_seen = []
+
+        def discover(self, config):
+            return [
+                {"name": "users", "supported_sync_modes": ["incremental"]},
+                {"name": "orders", "supported_sync_modes": ["incremental"]},
+            ]
+
+        def read(self, config, catalog, state=None):
+            self.states_seen.append(state)
+            cursors = {"users": 0, "orders": 0}
+            if state:
+                for m in state:
+                    desc = m["stream"]["stream_descriptor"]["name"]
+                    cursors[desc] = m["stream"]["stream_state"]["cursor"]
+            out = []
+            for name in ("users", "orders"):
+                for i in range(cursors[name], cursors[name] + 2):
+                    out.append(
+                        {
+                            "type": "RECORD",
+                            "record": {"stream": name, "data": {"s": name, "i": i}},
+                        }
+                    )
+                out.append({"type": "STATE", "state": stream_state(name, cursors[name] + 2)})
+            return out
+
+    r = R()
+    G.clear()
+    t = pw.io.airbyte.read(
+        {"source": {"config": {}, "executable": "x"}},
+        streams=["users", "orders"],
+        mode="streaming",
+        runner=r,
+        _poll_interval=0.05,
+    )
+    got = _collect(t)
+
+    def stop_after_polls():
+        deadline = time.time() + 20
+        while len(r.states_seen) < 3 and time.time() < deadline:
+            time.sleep(0.05)
+        rt = pw.internals.run.current_runtime()
+        if rt is not None:
+            rt.request_stop()
+
+    th = threading.Thread(target=stop_after_polls, daemon=True)
+    th.start()
+    pw.run(monitoring_level="none")
+    th.join()
+    # poll 1 starts stateless; poll 2+ must hand back BOTH streams' cursors
+    assert r.states_seen[0] is None
+    second = r.states_seen[1]
+    assert isinstance(second, list) and len(second) == 2, second
+    by_stream = {m["stream"]["stream_descriptor"]["name"]: m for m in second}
+    assert by_stream["users"]["stream"]["stream_state"] == {"cursor": 2}
+    assert by_stream["orders"]["stream"]["stream_state"] == {"cursor": 2}
+    third = r.states_seen[2]
+    assert {m["stream"]["stream_state"]["cursor"] for m in third} == {4}
+    # no duplicates: every (stream, i) pair appears exactly once per cursor step
+    vals = sorted((d.value["s"], d.value["i"]) for d in got.values())
+    assert vals == sorted(
+        [("users", i) for i in range(max(v[1] for v in vals if v[0] == "users") + 1)]
+        + [("orders", i) for i in range(max(v[1] for v in vals if v[0] == "orders") + 1)]
+    ), vals
+
+
 def test_airbyte_duplicate_payloads_are_distinct_rows():
     """Review r5: identical record payloads must not collapse — keys carry an
     occurrence ordinal per (stream, content)."""

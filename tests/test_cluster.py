@@ -90,7 +90,9 @@ def _run_cluster(script_path: str, out: str, *, processes: int, threads: int, ti
         PYTHONPATH=REPO,
     )
     if processes > 1:
-        env["PATHWAY_FIRST_PORT"] = str(_free_port_base(processes))
+        # the cluster occupies [first_port, first_port + processes + 1]
+        # (coordinator, peer links, heartbeat monitor)
+        env["PATHWAY_FIRST_PORT"] = str(_free_port_base(processes + 1))
     procs = []
     for pid in range(processes):
         penv = dict(env, PATHWAY_PROCESS_ID=str(pid))
@@ -152,18 +154,23 @@ def test_cluster_2x2_byte_identical(pipeline_script, tmp_path):
     assert _read(solo, ".window.csv") == _read(dist, ".window.csv")
 
 
-def test_cluster_dead_peer_raises_not_hangs(pipeline_script, tmp_path):
-    """A missing peer must produce a timeout error, not an infinite hang."""
+def test_cluster_dead_peer_raises_other_worker_error(pipeline_script, tmp_path):
+    """A peer that never joins the barrier must surface as a structured
+    ``OtherWorkerError`` naming the missing process within ``barrier_timeout``
+    — not an infinite hang, and not a bare ``RuntimeError`` (ISSUE 2)."""
+    import time as _time
+
     env = dict(os.environ)
     env.update(
         PATHWAY_PROCESSES="2",
         PATHWAY_THREADS="1",
         PATHWAY_PROCESS_ID="0",
-        PATHWAY_FIRST_PORT=str(_free_port_base(2)),
+        PATHWAY_FIRST_PORT=str(_free_port_base(3)),
         PATHWAY_BARRIER_TIMEOUT="3",
         JAX_PLATFORMS="cpu",
         PYTHONPATH=REPO,
     )
+    t0 = _time.monotonic()
     p = subprocess.Popen(
         [sys.executable, pipeline_script, str(tmp_path / "dead")],
         env=env,
@@ -176,7 +183,12 @@ def test_cluster_dead_peer_raises_not_hangs(pipeline_script, tmp_path):
     except subprocess.TimeoutExpired:
         p.kill()
         raise AssertionError("process 0 hung forever on a dead peer")
+    elapsed = _time.monotonic() - t0
     assert p.returncode != 0
+    assert "OtherWorkerError" in stdout, stdout
+    assert "never joined" in stdout, stdout
+    # detection within barrier_timeout (3s) plus interpreter startup slack
+    assert elapsed < 45, f"dead-peer detection took {elapsed:.1f}s"
 
 
 _INDEX_PIPELINE = textwrap.dedent(
