@@ -1,0 +1,125 @@
+"""Live-tracing overhead benchmark (ISSUE 3 acceptance gate).
+
+Measures the streaming engine's throughput with the observability plane in
+its three modes on an identical pipeline:
+
+- ``trace_off``     — ``PATHWAY_TRACE=off`` (the default): no tracer installed,
+  hot loops pay one ``is None`` test per guard. This is the r6-equivalent
+  baseline (the pre-observability engine had no guard at all, so any
+  regression of the default mode shows up here against BENCH_r06-era rates).
+- ``trace_sampled`` — ``PATHWAY_TRACE=on`` + ``PATHWAY_TRACE_SAMPLE=0.1``:
+  every 10th tick records its full span tree.
+- ``trace_full``    — ``PATHWAY_TRACE=on`` at rate 1.0 with the rotating
+  OTLP-JSON file sink attached: every tick, every sweep span, written out.
+
+The pipeline is a pure-engine streaming run (timed fixture → with_columns →
+groupby → subscribe) over ``N_EVENTS`` rows in ``TICK_ROWS``-row ticks — no
+device UDFs, so span bookkeeping is the largest per-tick cost and the
+measurement is the WORST case for tracing overhead.
+
+Gate: ``trace_full`` must stay within 10% of ``trace_off`` throughput
+(exit 1 otherwise); ``trace_sampled`` is reported and asserted <10% as well.
+
+Run: ``python benchmarks/observability_bench.py [N_EVENTS]``. Prints one JSON
+line (written to BENCH_r08.json by CI).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import statistics
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+TICK_ROWS = 64
+REPS = 5
+
+
+def _run_once(n_events: int, tmp_trace: str | None) -> float:
+    """One streaming run; returns rows/s. Trace env is set by the caller."""
+    import pathway_tpu as pw
+    from pathway_tpu.internals.parse_graph import G
+
+    G.clear()
+    t = pw.debug.table_from_rows(
+        pw.schema_from_types(x=int),
+        [(i, i // TICK_ROWS, 1) for i in range(n_events)],
+        is_stream=True,
+    )
+    t = t.with_columns(m=t.x % 7)
+    g = t.groupby(t.m).reduce(s=pw.reducers.sum(t.x), c=pw.reducers.count())
+    seen = []
+    pw.io.subscribe(g, on_change=lambda **k: seen.append(1))
+    t0 = time.perf_counter()
+    pw.run(monitoring_level="none")
+    elapsed = time.perf_counter() - t0
+    assert seen, "pipeline produced no output"
+    return n_events / elapsed
+
+
+def _set_mode(mode: str, tmp_dir: str) -> None:
+    os.environ.pop("PATHWAY_TRACE", None)
+    os.environ.pop("PATHWAY_TRACE_SAMPLE", None)
+    os.environ.pop("PATHWAY_TRACE_LIVE_FILE", None)
+    if mode == "trace_off":
+        os.environ["PATHWAY_TRACE"] = "off"
+    elif mode == "trace_sampled":
+        os.environ["PATHWAY_TRACE"] = "on"
+        os.environ["PATHWAY_TRACE_SAMPLE"] = "0.1"
+    elif mode == "trace_full":
+        os.environ["PATHWAY_TRACE"] = "on"
+        os.environ["PATHWAY_TRACE_SAMPLE"] = "1.0"
+        os.environ["PATHWAY_TRACE_LIVE_FILE"] = os.path.join(
+            tmp_dir, "bench_trace.jsonl"
+        )
+    else:
+        raise ValueError(mode)
+
+
+def main() -> int:
+    import tempfile
+
+    n_events = int(sys.argv[1]) if len(sys.argv) > 1 else 64_000
+    tmp_dir = tempfile.mkdtemp(prefix="obs_bench_")
+    _run_once(min(n_events, 8_000), None)  # warmup (imports, jit-free paths)
+
+    modes = ("trace_off", "trace_sampled", "trace_full")
+    # interleave the reps across modes so slow machine drift (shared CI
+    # hosts) cancels, and take each mode's BEST rep: external noise only ever
+    # slows a run, so best-vs-best is the drift-robust overhead comparison
+    rates: dict[str, list[float]] = {m: [] for m in modes}
+    for _ in range(REPS):
+        for mode in modes:
+            _set_mode(mode, tmp_dir)
+            rates[mode].append(_run_once(n_events, None))
+    results: dict = {"bench": "observability_overhead", "n_events": n_events,
+                     "tick_rows": TICK_ROWS, "reps": REPS}
+    for mode in modes:
+        results[f"{mode}_rows_per_s"] = round(max(rates[mode]), 1)
+        results[f"{mode}_rows_per_s_all"] = [round(r, 1) for r in rates[mode]]
+    off = results["trace_off_rows_per_s"]
+    results["sampled_overhead_pct"] = round(
+        100.0 * (1 - results["trace_sampled_rows_per_s"] / off), 2
+    )
+    results["full_overhead_pct"] = round(
+        100.0 * (1 - results["trace_full_rows_per_s"] / off), 2
+    )
+    ok = results["full_overhead_pct"] <= 10.0 and results["sampled_overhead_pct"] <= 10.0
+    results["within_budget"] = ok
+    print(json.dumps(results))
+    if not ok:
+        print(
+            f"FAIL: tracing overhead exceeds 10% budget "
+            f"(sampled {results['sampled_overhead_pct']}%, "
+            f"full {results['full_overhead_pct']}%)",
+            file=sys.stderr,
+        )
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

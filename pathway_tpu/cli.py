@@ -65,15 +65,41 @@ def cli() -> None:
 @click.option("--first-port", type=int, default=None, help="base TCP port for the cluster plane")
 @click.option("--record", is_flag=True, default=False, help="record inputs for later replay")
 @click.option("--record-path", type=str, default="./record", help="where recorded inputs live")
+@click.option(
+    "--trace",
+    type=click.Choice(["off", "on"]),
+    default=None,
+    help="live span pipeline (PATHWAY_TRACE)",
+)
+@click.option(
+    "--trace-sample", type=float, default=None, help="tick head-sampling rate (0, 1]"
+)
+@click.option(
+    "--trace-file",
+    type=str,
+    default=None,
+    help="rotating OTLP-JSON live sink (per-process .pN suffix)",
+)
 @click.argument("program", nargs=-1, type=click.UNPROCESSED)
-def spawn(threads, processes, first_port, record, record_path, program):
+def spawn(threads, processes, first_port, record, record_path, trace, trace_sample, trace_file, program):
     """Run PROGRAM across THREADS×PROCESSES workers on this host."""
+    import uuid
+
     env = dict(os.environ)
     env["PATHWAY_THREADS"] = str(threads)
     env["PATHWAY_PROCESSES"] = str(processes)
     env["PATHWAY_FIRST_PORT"] = str(
         first_port if first_port is not None else get_pathway_config().first_port
     )
+    # one run id per launch: every process derives the SAME trace id from it,
+    # so per-process tick spans (live + offline exports) stitch into one trace
+    env.setdefault("PATHWAY_RUN_ID", uuid.uuid4().hex)
+    if trace is not None:
+        env["PATHWAY_TRACE"] = trace
+    if trace_sample is not None:
+        env["PATHWAY_TRACE_SAMPLE"] = str(trace_sample)
+    if trace_file is not None:
+        env["PATHWAY_TRACE_LIVE_FILE"] = trace_file
     if record:
         env["PATHWAY_PERSISTENT_STORAGE"] = record_path
         env["PATHWAY_RECORD"] = "1"
@@ -84,8 +110,11 @@ def spawn(threads, processes, first_port, record, record_path, program):
 @click.argument("program", nargs=-1, type=click.UNPROCESSED)
 def spawn_from_env(program):
     """Like spawn, but topology comes from the current PATHWAY_* environment."""
+    import uuid
+
     cfg = get_pathway_config()
     env = cfg.spawn_env(0)
+    env.setdefault("PATHWAY_RUN_ID", uuid.uuid4().hex)  # shared trace id
     sys.exit(_spawn_processes(env, cfg.processes, program))
 
 

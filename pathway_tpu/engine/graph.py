@@ -162,6 +162,10 @@ class Scheduler:
         self.graph = graph
         self.current_time = 0
         self.on_tick_done: list[Callable[[int], None]] = []
+        # live tracing (observability plane): None when PATHWAY_TRACE=off —
+        # the hot loops below pay exactly one is-not-None test per guard
+        self.tracer = None
+        self._trace_active = False
 
     def _route(self, producer: Node, batches: list[DeltaBatch]) -> bool:
         routed = False
@@ -178,14 +182,29 @@ class Scheduler:
     def _sweep(self, time: int) -> bool:
         """One topo pass; returns True if any node did work."""
         any_work = False
+        trace = self._trace_active
         for node in self.graph.nodes:
             if not node.has_pending():
                 continue
             inputs = node.drain()
-            node.stats_rows_in += sum(len(b) for b in inputs if b is not None)
+            rows_in = sum(len(b) for b in inputs if b is not None)
+            node.stats_rows_in += rows_in
+            if trace:
+                w0 = _time.time_ns()
             t0 = _time.perf_counter_ns()
             out = _run_annotated(node, node.process, inputs, time)
             node.stats_time_ns += _time.perf_counter_ns() - t0
+            if trace:
+                self.tracer.span(
+                    f"sweep/{node.name}",
+                    w0,
+                    _time.time_ns(),
+                    {
+                        "pathway.operator.id": node.node_index,
+                        "pathway.rows_in": rows_in,
+                        "pathway.rows_out": sum(len(b) for b in out if b is not None),
+                    },
+                )
             self._route(node, out)
             any_work = True
         return any_work
@@ -194,6 +213,9 @@ class Scheduler:
         """Process everything pending at logical ``time`` to quiescence, then
         advance the frontier past it."""
         self.current_time = time
+        tracer = self.tracer
+        tick_token = tracer.begin_tick(time) if tracer is not None else None
+        self._trace_active = tick_token is not None
         for node in self.graph.nodes:
             self._route(node, _run_annotated(node, node.poll, time))
         while self._sweep(time):
@@ -213,6 +235,9 @@ class Scheduler:
             _run_annotated(node, node.on_tick_complete, time)
         for cb in self.on_tick_done:
             cb(time)
+        if tick_token is not None:
+            self._trace_active = False
+            tracer.end_tick(time, tick_token)
 
     def close(self) -> None:
         """Input exhausted: flush temporal buffers and fire end callbacks."""

@@ -65,12 +65,23 @@ class Runtime:
         self._stop_requested = True
 
     def run(self, outputs: list[LogicalNode]) -> Scheduler:
+        from pathway_tpu import observability as _obs
         from pathway_tpu.resilience import faults as _faults
 
         _faults.install_from_env()
+        _obs.install_from_env(self)
+        try:
+            return self._run(outputs, _obs.current())
+        finally:
+            _obs.shutdown()
+
+    def _run(self, outputs: list[LogicalNode], tracer) -> Scheduler:
+        from pathway_tpu.resilience import faults as _faults
+
         ctx = build_engine_graph(outputs, runtime=self)
         self.streaming = bool(self.connectors)
         scheduler = Scheduler(ctx.graph)
+        scheduler.tracer = tracer
         self.scheduler = scheduler
 
         if self.persistence is not None:

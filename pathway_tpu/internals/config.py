@@ -179,6 +179,53 @@ class PathwayConfig:
         return os.environ.get("PATHWAY_MONITORING_SERVER")
 
     @property
+    def monitoring_http_host(self) -> str:
+        """Bind host for the monitoring HTTP server. Default stays loopback;
+        multi-host TPU-VM pods set ``0.0.0.0`` (or the NIC address) so peers'
+        ``/metrics`` are scrapable across the pod."""
+        return os.environ.get("PATHWAY_MONITORING_HTTP_HOST", "127.0.0.1")
+
+    # ---- live tracing (observability plane) ---------------------------------
+    @property
+    def trace_mode(self) -> str:
+        """Live span pipeline master switch: ``off`` (default — no tracer is
+        installed, hot loops pay one ``is None`` test) or ``on``."""
+        raw = os.environ.get("PATHWAY_TRACE", "off").strip().lower()
+        if raw in ("", "0", "false", "no", "off"):
+            return "off"
+        if raw in ("1", "true", "yes", "on", "full", "live"):
+            return "on"
+        raise ValueError(f"PATHWAY_TRACE must be off/on, got {raw!r}")
+
+    @property
+    def trace_sample(self) -> float:
+        """Head-sampling rate in (0, 1]: the fraction of TICKS traced (a
+        sampled tick records all its child spans; an unsampled one records
+        none). The tick hash is deterministic, so every cluster process
+        samples the same ticks."""
+        rate = _env_float("PATHWAY_TRACE_SAMPLE", 1.0)
+        if not 0.0 < rate <= 1.0:
+            raise ValueError(
+                f"PATHWAY_TRACE_SAMPLE must be in (0, 1], got {rate}"
+            )
+        return rate
+
+    @property
+    def trace_live_file(self) -> str | None:
+        """Rotating OTLP-JSON live sink (one ExportTraceServiceRequest per
+        line); cluster processes suffix ``.p<id>``. Unset = ring buffer only
+        (served by ``/trace?since=``)."""
+        return os.environ.get("PATHWAY_TRACE_LIVE_FILE") or None
+
+    @property
+    def trace_buffer_spans(self) -> int:
+        return max(64, _env_int("PATHWAY_TRACE_BUFFER", 8192))
+
+    @property
+    def trace_rotate_mb(self) -> int:
+        return max(1, _env_int("PATHWAY_TRACE_ROTATE_MB", 64))
+
+    @property
     def run_id(self) -> str:
         return os.environ.get("PATHWAY_RUN_ID", "")
 

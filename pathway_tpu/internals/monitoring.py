@@ -24,14 +24,9 @@ def scheduler_stats(scheduler) -> list[dict[str, Any]]:
     """Per-operator counters from a live or finished scheduler. Sharded and
     cluster runtimes expose per-worker graphs; their counters aggregate by
     node position."""
-    if scheduler is None:
-        return []
-    graph = getattr(scheduler, "graph", None)
-    if graph is not None:
-        graphs = [graph]
-    else:
-        workers = getattr(scheduler, "workers", None) or []
-        graphs = [w.graph for w in workers if getattr(w, "graph", None) is not None]
+    from pathway_tpu.observability.metrics import iter_graphs
+
+    graphs = iter_graphs(scheduler)
     agg: dict[int, dict[str, Any]] = {}
     for g in graphs:
         for node in g.nodes:
@@ -82,25 +77,84 @@ def _visible_operators(ops: list[dict], level: str) -> list[dict]:
 
 
 def run_stats(runtime) -> dict[str, Any]:
+    from pathway_tpu import observability as _obs
     from pathway_tpu.internals.telemetry import resilience_summary
+    from pathway_tpu.observability.metrics import Histogram
 
     scheduler = getattr(runtime, "scheduler", None)
     ops = scheduler_stats(scheduler)
-    return {
+    def _q(snap, q):
+        v = Histogram.quantile(snap, q)
+        # the +Inf overflow bucket has no finite upper bound — keep /status
+        # strict JSON (no Infinity literal)
+        return None if v is None or v == float("inf") else v
+
+    sink_lat = {}
+    for label, snap in _obs.run_metrics().sink_snapshots().items():
+        sink_lat[label] = {
+            "count": snap["count"],
+            "sum_s": round(snap["sum_s"], 6),
+            "p50_s": _q(snap, 0.5),
+            "p99_s": _q(snap, 0.99),
+        }
+    stats = {
         "alive": True,
         "current_time": getattr(scheduler, "current_time", None),
         "operators": ops,
         "rows_in_total": sum(o["rows_in"] for o in ops),
         "rows_out_total": sum(o["rows_out"] for o in ops),
+        # live observability plane: per-input watermarks, queue/microbatch
+        # backlogs, per-sink end-to-end latency summaries
+        "watermarks": _obs.input_watermarks(scheduler),
+        "backlogs": _obs.backlog_gauges(scheduler),
+        "sink_latency": sink_lat,
         # recovery observability: heartbeat misses, committed checkpoint
         # epochs, replayed events and supervised restarts, from the same
         # event log the OTLP exports consume (``internals/telemetry.py``)
         "resilience": resilience_summary(),
     }
+    tracer = _obs.current()
+    if tracer is not None:
+        stats["trace"] = {
+            "trace_id": tracer.trace_id,
+            "sample": tracer.sample,
+            "spans": tracer.buffer._seq,
+        }
+    server = getattr(runtime, "monitoring_server", None)
+    if server is not None:
+        stats["monitoring"] = {"host": server.host, "port": server.port}
+    # coordinator of a cluster run: every process's summary, from the
+    # telemetry piggybacked on heartbeats (observability.aggregate)
+    cluster = _obs.aggregate.cluster_status(runtime)
+    if cluster is not None:
+        stats["cluster"] = cluster
+    return stats
+
+
+def escape_label_value(value: Any) -> str:
+    r"""Prometheus exposition label-value escaping: ``\`` → ``\\``, ``"`` →
+    ``\"``, newline → ``\n`` (the spec's exhaustive list). Operator names come
+    from user pipelines (UDF/table names ride along), so they can contain any
+    of the three."""
+    return (
+        str(value)
+        .replace("\\", "\\\\")
+        .replace('"', '\\"')
+        .replace("\n", "\\n")
+    )
+
+
+def _fmt_label(**labels: Any) -> str:
+    return ",".join(f'{k}="{escape_label_value(v)}"' for k, v in labels.items())
 
 
 def prometheus_text(runtime) -> str:
-    """Prometheus exposition format (``http_server.rs`` metric names adapted)."""
+    """Prometheus exposition format (``http_server.rs`` metric names adapted),
+    extended with the live plane: per-input watermarks, backlog gauges and
+    per-sink end-to-end latency histograms (fixed log-2 buckets)."""
+    from pathway_tpu import observability as _obs
+    from pathway_tpu.observability.metrics import BUCKET_BOUNDS_S
+
     stats = run_stats(runtime)
     metrics = [
         ("pathway_operator_rows_in_total", "Rows consumed by an operator", "rows_in", "counter"),
@@ -109,7 +163,9 @@ def prometheus_text(runtime) -> str:
         ("pathway_operator_latency_ms", "Input queue latency (EWMA) of an operator", "latency_ms", "gauge"),
         ("pathway_operator_lag", "Logical ticks behind the most-advanced operator", "lag", "gauge"),
     ]
-    labels = [f'operator="{o["operator"]}",id="{o["id"]}"' for o in stats["operators"]]
+    labels = [
+        _fmt_label(operator=o["operator"], id=o["id"]) for o in stats["operators"]
+    ]
     lines = []
     for name, help_text, field, mtype in metrics:
         lines.append(f"# HELP {name} {help_text}")
@@ -118,14 +174,100 @@ def prometheus_text(runtime) -> str:
             if o[field] is None:
                 continue
             lines.append(f"{name}{{{label}}} {o[field]}")
+    # ---- watermarks + ingest counters per input connector -------------------
+    wms = stats["watermarks"]
+    if wms:
+        lines.append("# HELP pathway_input_watermark_unix_seconds Event-time (or ingest-time) watermark of an input connector")
+        lines.append("# TYPE pathway_input_watermark_unix_seconds gauge")
+        for w in wms:
+            if w["watermark"] is not None:
+                lines.append(
+                    f'pathway_input_watermark_unix_seconds{{{_fmt_label(input=w["input"])}}} {w["watermark"]}'
+                )
+        lines.append("# HELP pathway_input_watermark_lag_seconds Now minus the input watermark")
+        lines.append("# TYPE pathway_input_watermark_lag_seconds gauge")
+        for w in wms:
+            if w["lag_s"] is not None:
+                lines.append(
+                    f'pathway_input_watermark_lag_seconds{{{_fmt_label(input=w["input"])}}} {w["lag_s"]}'
+                )
+        lines.append("# HELP pathway_input_rows_ingested_total Rows ingested by an input connector")
+        lines.append("# TYPE pathway_input_rows_ingested_total counter")
+        for w in wms:
+            lines.append(
+                f'pathway_input_rows_ingested_total{{{_fmt_label(input=w["input"])}}} {w["rows_ingested"]}'
+            )
+    # ---- backlog gauges (connector queues + cross-tick microbatch buffers) --
+    backlogs = stats["backlogs"]
+    if backlogs:
+        lines.append("# HELP pathway_backlog_rows Rows buffered in a connector queue or microbatch buffer")
+        lines.append("# TYPE pathway_backlog_rows gauge")
+        for b in backlogs:
+            lines.append(
+                f'pathway_backlog_rows{{{_fmt_label(queue=b["queue"])}}} {b["rows"]}'
+            )
+    # ---- per-sink end-to-end latency histograms -----------------------------
+    snaps = _obs.run_metrics().sink_snapshots()
+    if snaps:
+        lines.append("# HELP pathway_sink_latency_seconds End-to-end ingest-to-emit latency per sink")
+        lines.append("# TYPE pathway_sink_latency_seconds histogram")
+        for label, snap in snaps.items():
+            cum = 0
+            for bound, c in zip(BUCKET_BOUNDS_S, snap["counts"]):
+                cum += c
+                lines.append(
+                    f'pathway_sink_latency_seconds_bucket{{{_fmt_label(sink=label, le=repr(bound))}}} {cum}'
+                )
+            cum += snap["counts"][-1]
+            lines.append(
+                f'pathway_sink_latency_seconds_bucket{{{_fmt_label(sink=label)},le="+Inf"}} {cum}'
+            )
+            lines.append(
+                f'pathway_sink_latency_seconds_sum{{{_fmt_label(sink=label)}}} {snap["sum_s"]}'
+            )
+            lines.append(
+                f'pathway_sink_latency_seconds_count{{{_fmt_label(sink=label)}}} {snap["count"]}'
+            )
     return "\n".join(lines) + "\n"
 
 
-class MonitoringHttpServer:
-    """``/status`` + ``/metrics`` over a daemon thread for the run's lifetime."""
+def _trace_payload(query: str) -> bytes:
+    """``/trace?since=<cursor>`` body: live spans recorded after the cursor
+    (OTLP span dicts) + the next cursor, so a poller tails the span stream
+    incrementally. Empty when tracing is off (``PATHWAY_TRACE=off``)."""
+    from urllib.parse import parse_qs
 
-    def __init__(self, runtime, port: int | None = None):
+    from pathway_tpu import observability as _obs
+
+    since = 0
+    try:
+        since = int(parse_qs(query).get("since", ["0"])[0])
+    except (ValueError, TypeError):
+        pass
+    tracer = _obs.current()
+    if tracer is None:
+        doc = {"enabled": False, "spans": [], "next": since}
+    else:
+        spans, next_seq = tracer.buffer.since(since)
+        doc = {
+            "enabled": True,
+            "traceId": tracer.trace_id,
+            "sample": tracer.sample,
+            "spans": spans,
+            "next": next_seq,
+        }
+    return json.dumps(doc).encode()
+
+
+class MonitoringHttpServer:
+    """``/status`` + ``/metrics`` + ``/trace`` over a daemon thread for the
+    run's lifetime. Binds ``PATHWAY_MONITORING_HTTP_HOST`` (default loopback;
+    multi-host TPU-VM pods set an external address so peers are scrapable)."""
+
+    def __init__(self, runtime, port: int | None = None, host: str | None = None):
         import os
+
+        from pathway_tpu.internals.config import get_pathway_config
 
         self.runtime = runtime
         if port is None:
@@ -134,6 +276,8 @@ class MonitoringHttpServer:
             # workers don't collide on the bind (reference http_server.rs)
             port = 0 if base == 0 else base + int(os.environ.get("PATHWAY_PROCESS_ID", "0"))
         self.port = port
+        self.host = host if host is not None else get_pathway_config().monitoring_http_host
+        self._stopped = False
         rt = runtime
 
         class Handler(BaseHTTPRequestHandler):
@@ -141,11 +285,15 @@ class MonitoringHttpServer:
                 pass
 
             def do_GET(self):
-                if self.path.startswith("/metrics"):
+                path, _, query = self.path.partition("?")
+                if path.rstrip("/") == "/metrics":
                     body = prometheus_text(rt).encode()
                     ctype = "text/plain; version=0.0.4"
-                elif self.path.startswith("/status"):
+                elif path.rstrip("/") == "/status":
                     body = json.dumps(run_stats(rt)).encode()
+                    ctype = "application/json"
+                elif path.rstrip("/") == "/trace":
+                    body = _trace_payload(query)
                     ctype = "application/json"
                 else:
                     self.send_response(404)
@@ -157,7 +305,7 @@ class MonitoringHttpServer:
                 self.end_headers()
                 self.wfile.write(body)
 
-        self.server = ThreadingHTTPServer(("127.0.0.1", self.port), Handler)
+        self.server = ThreadingHTTPServer((self.host, self.port), Handler)
         self.port = self.server.server_address[1]
         self.thread = threading.Thread(target=self.server.serve_forever, daemon=True)
 
@@ -166,8 +314,16 @@ class MonitoringHttpServer:
         return self
 
     def stop(self) -> None:
-        self.server.shutdown()
-        self.server.server_close()
+        # idempotent + exception-safe: runs in ``finally`` blocks after failed
+        # runs, possibly twice (interactive handle + run teardown)
+        if self._stopped:
+            return
+        self._stopped = True
+        try:
+            self.server.shutdown()
+        finally:
+            self.server.server_close()
+        self.thread.join(timeout=5.0)
 
 
 class LiveDashboard:

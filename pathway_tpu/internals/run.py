@@ -122,6 +122,9 @@ def run(
         from pathway_tpu.internals.monitoring import MonitoringHttpServer
 
         http_server = MonitoringHttpServer(runtime).start()
+        # run_stats reports the bound host:port (cluster peers offset the
+        # port by process id — this is where a scraper learns the real one)
+        runtime.monitoring_server = http_server
     if terminate_on_error is None:
         # kwarg beats PATHWAY_TERMINATE_ON_ERROR beats True
         terminate_on_error = get_pathway_config().terminate_on_error

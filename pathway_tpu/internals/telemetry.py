@@ -197,16 +197,34 @@ def export_run_trace(
 ) -> dict:
     """Write one OTLP/JSON trace document for a finished (or stopping) run;
     returns the document (tests introspect it)."""
+    from pathway_tpu import observability as _obs
+    from pathway_tpu.internals.config import get_pathway_config
     from pathway_tpu.internals.monitoring import run_stats
 
     stats = run_stats(runtime)
-    trace_id = secrets.token_hex(16)
-    root_id = secrets.token_hex(8)
-    spans = [
-        {
+    # trace id derives from PATHWAY_RUN_ID when set (spawn exports one per
+    # cluster launch), so every process's offline doc — and the live span
+    # plane — stitch under ONE trace; the deterministic root-span id lets
+    # peers parent their subtree to process 0's root without coordination
+    cfg = get_pathway_config()
+    trace_id = _obs.run_trace_id()
+    shared_root = _obs.spans.derive_root_span_id(trace_id)
+    if cfg.processes > 1 and cfg.process_id != 0 and cfg.run_id:
+        # only with a shared run id does process 0 emit the span this parent
+        # id names — without one, trace ids are per-process random and a
+        # parent link would dangle (orphaned subtree in Perfetto)
+        root_id = secrets.token_hex(8)
+        root_span = {
             "traceId": trace_id,
             "spanId": root_id,
-            "name": "pathway.run",
+            "parentSpanId": shared_root,
+            "name": f"pathway.run.p{cfg.process_id}",
+        }
+    else:
+        root_id = shared_root
+        root_span = {"traceId": trace_id, "spanId": root_id, "name": "pathway.run"}
+    root_span.update(
+        {
             "kind": 1,  # SPAN_KIND_INTERNAL
             "startTimeUnixNano": str(start_ns),
             "endTimeUnixNano": str(end_ns),
@@ -214,13 +232,15 @@ def export_run_trace(
                 _attr("pathway.n_operators", len(stats["operators"])),
                 _attr("pathway.rows_in_total", stats["rows_in_total"]),
                 _attr("pathway.rows_out_total", stats["rows_out_total"]),
+                _attr("pathway.process_id", cfg.process_id),
                 _attr(
                     "pathway.n_workers",
                     len(getattr(runtime, "workers", None) or []) or 1,
                 ),
             ],
         }
-    ]
+    )
+    spans = [root_span]
     for op in stats["operators"]:
         attrs = [
             _attr("pathway.operator.id", op["id"]),

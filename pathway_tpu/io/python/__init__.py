@@ -254,6 +254,24 @@ class _TimedInputNode(ops.StreamInputNode):
         # every worker's build and across successive pw.run calls on the same
         # fixture — a downstream in-place mutation of a view would corrupt the
         # fixture for other workers/runs (ADVICE r5)
+        # watermark probes (the per-row push path stamps these in push();
+        # this columnarized fast lane must stamp them itself)
+        import time as _t
+
+        now_ns = _t.time_ns()
+        self.wm_rows += emit_until - sl.start
+        self.wm_ingest_ns = now_ns
+        if self.event_time_index is not None:
+            col = self._data_arrs[self.columns[self.event_time_index]][sl]
+            try:
+                et = float(max(col))
+                if self.wm_event_time is None or et > self.wm_event_time:
+                    self.wm_event_time = et
+            except (TypeError, ValueError):
+                pass
+        from pathway_tpu.observability.metrics import run_metrics
+
+        run_metrics().note_tick_ingest(time, now_ns)
         batch = DeltaBatch(
             self._keys_arr[sl].copy(),
             self._diffs_arr[sl].copy(),
@@ -292,12 +310,18 @@ def read(
     schema: schema_mod.SchemaMetaclass,
     autocommit_duration_ms: int | None = None,
     name: str | None = None,
+    event_time_column: str | None = None,
     **kwargs: Any,
 ) -> Table:
     columns = schema.column_names()
     np_dtypes = schema.np_dtypes()
     subject._columns = columns
     subject._pk_cols = schema.primary_key_columns()
+    # observability: the named column drives this input's EVENT-TIME watermark
+    # (``/metrics`` pathway_input_watermark; default is processing time)
+    event_time_index = (
+        columns.index(event_time_column) if event_time_column is not None else None
+    )
 
     if isinstance(subject, _StaticStreamSubject):
         holder: dict[str, Any] = {}
@@ -308,6 +332,8 @@ def read(
 
         def factory() -> Node:
             node = _TimedInputNode(events, columns, np_dtypes, arrays=arrays)
+            node.event_time_index = event_time_index
+            node.input_name = name or "stream_fixture"
             holder["node"] = node
             return node
 
@@ -322,6 +348,8 @@ def read(
         node = ops.StreamInputNode(
             columns, np_dtypes, upsert=subject._session_type == "upsert"
         )
+        node.event_time_index = event_time_index
+        node.input_name = name or getattr(subject, "datasource_name", None) or "python"
         subject._node = node
         return node
 

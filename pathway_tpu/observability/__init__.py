@@ -1,0 +1,125 @@
+"""Live telemetry plane: streaming spans, watermarks, latency histograms,
+cluster aggregation.
+
+The offline exports (``internals/telemetry.py``) write one OTLP document at
+run END; this package streams the same planes *while the pipeline runs* —
+the observability the ROADMAP's live-RAG serving target actually needs:
+
+- ``spans``    — Dapper-style head-sampled tick/operator/device/cluster spans,
+  ring-buffered for ``/trace?since=`` and appended to a rotating OTLP-JSON
+  file (``PATHWAY_TRACE=on``, ``PATHWAY_TRACE_SAMPLE``,
+  ``PATHWAY_TRACE_LIVE_FILE``);
+- ``metrics``  — per-input watermarks, per-sink end-to-end latency histograms
+  (log-2 buckets → Prometheus histograms on ``/metrics``), backlog gauges;
+- ``aggregate``— peers ship summaries to the coordinator on the heartbeat
+  plane; process 0's ``/status`` shows every process.
+
+Lifecycle: each runtime ``run()`` calls :func:`install_from_env` (next to the
+fault-plan install) and :func:`shutdown` in its run wrapper; ``current()`` is
+the hot-path accessor — **None when tracing is off**, so engine loops pay one
+``is None`` test.
+"""
+
+from __future__ import annotations
+
+import secrets
+
+from pathway_tpu.observability import aggregate, metrics, spans
+from pathway_tpu.observability.metrics import (
+    BUCKET_BOUNDS_S,
+    Histogram,
+    backlog_gauges,
+    input_watermarks,
+    run_metrics,
+)
+from pathway_tpu.observability.spans import (
+    RotatingTraceSink,
+    SpanBuffer,
+    Tracer,
+    derive_trace_id,
+)
+
+_tracer: Tracer | None = None
+
+
+def current() -> Tracer | None:
+    """The installed live tracer, or None when tracing is off."""
+    return _tracer
+
+
+def run_trace_id() -> str:
+    """The trace id this run's spans carry: derived deterministically from
+    ``PATHWAY_RUN_ID`` when set (cluster processes share it → one stitched
+    trace), else random per process."""
+    from pathway_tpu.internals.config import get_pathway_config
+
+    run_id = get_pathway_config().run_id
+    if run_id:
+        return derive_trace_id(run_id)
+    return secrets.token_hex(16)
+
+
+def install_from_env(runtime=None) -> Tracer | None:
+    """Install the run's live telemetry (called by every runtime's ``run``,
+    next to ``faults.install_from_env``): reset the per-run metrics state,
+    and build a tracer when ``PATHWAY_TRACE`` is on. Idempotent per run —
+    a previous run's tracer is closed first."""
+    global _tracer
+    from pathway_tpu.internals.config import get_pathway_config
+
+    metrics.reset()
+    if _tracer is not None:
+        try:
+            _tracer.close(emit_root=False)
+        except Exception:
+            pass
+        _tracer = None
+    cfg = get_pathway_config()
+    if cfg.trace_mode == "off":
+        return None
+    sink = None
+    path = cfg.trace_live_file
+    if path:
+        if cfg.processes > 1:
+            path = f"{path}.p{cfg.process_id}"
+        sink = RotatingTraceSink(path, rotate_bytes=cfg.trace_rotate_mb * 1024 * 1024)
+    _tracer = Tracer(
+        trace_id=run_trace_id(),
+        process_id=cfg.process_id,
+        sample=cfg.trace_sample,
+        buffer=SpanBuffer(max_spans=cfg.trace_buffer_spans, sink=sink),
+    )
+    return _tracer
+
+
+def shutdown() -> None:
+    """Close the live tracer (flush + root span + file sink). Never raises —
+    runs in ``finally`` blocks next to connector/server teardown."""
+    global _tracer
+    if _tracer is None:
+        return
+    try:
+        _tracer.close()
+    except Exception:
+        pass
+    _tracer = None
+
+
+__all__ = [
+    "BUCKET_BOUNDS_S",
+    "Histogram",
+    "RotatingTraceSink",
+    "SpanBuffer",
+    "Tracer",
+    "aggregate",
+    "backlog_gauges",
+    "current",
+    "derive_trace_id",
+    "input_watermarks",
+    "install_from_env",
+    "metrics",
+    "run_metrics",
+    "run_trace_id",
+    "shutdown",
+    "spans",
+]

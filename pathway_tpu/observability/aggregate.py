@@ -1,0 +1,81 @@
+"""Cluster-wide telemetry aggregation over the heartbeat control plane.
+
+Peers already send ``("hb", pid, tick)`` to process 0 every
+``heartbeat_interval`` seconds (``resilience/heartbeat.py``); this module
+piggybacks a compact telemetry summary on that same message — no new sockets,
+no new threads — so the coordinator's ``/status`` shows the WHOLE cluster:
+per-process tick, watermark minimum, backlog, row totals, sink-latency
+histogram snapshots (merged positionally — fixed buckets), and resilience
+counters. The reference's monitoring server is per-process; aggregating at
+process 0 is what a multi-host TPU-VM pod actually needs (one scrape target).
+"""
+
+from __future__ import annotations
+
+import time as _time
+from typing import Any
+
+from pathway_tpu.observability import metrics as _metrics
+
+
+def local_summary(runtime) -> dict[str, Any]:
+    """This process's telemetry summary — small (rides every heartbeat), built
+    from probes the engine already maintains. Safe to call from the heartbeat
+    thread mid-tick: readers tolerate torn counters (monitoring reads race the
+    engine the same way)."""
+    from pathway_tpu.internals.telemetry import resilience_summary
+
+    scheduler = getattr(runtime, "scheduler", None)
+    rows_in = 0
+    rows_out = 0
+    backlog = 0
+    for g in _metrics.iter_graphs(scheduler):
+        for node in g.nodes:
+            if hasattr(node, "wm_rows"):
+                rows_in += node.wm_rows
+                backlog += len(getattr(node, "_pending", ()))
+            elif node.name == "microbatch_select":
+                backlog += len(getattr(node, "waiting", ()))
+            if node.name in ("subscribe", "capture", "output"):
+                rows_out += node.stats_rows_in
+    return {
+        "tick": getattr(scheduler, "current_time", None),
+        "watermark": _metrics.min_watermark(scheduler),
+        "backlog_rows": backlog,
+        "rows_in": rows_in,
+        "rows_out": rows_out,
+        "sink_latency": _metrics.run_metrics().sink_snapshots(),
+        "resilience": resilience_summary(),
+        "ts_unix": round(_time.time(), 3),
+    }
+
+
+def cluster_status(runtime) -> dict[str, Any] | None:
+    """Coordinator view: every process's latest summary (self computed live,
+    peers from their heartbeats) + cluster-level rollups. None off-cluster or
+    on non-coordinator processes (their /status stays process-local)."""
+    monitor = getattr(runtime, "hb_monitor", None)
+    if monitor is None or not hasattr(monitor, "peer_summaries"):
+        return None
+    processes: dict[str, Any] = {"0": local_summary(runtime)}
+    for pid, summary in sorted(monitor.peer_summaries().items()):
+        if summary is not None:
+            processes[str(pid)] = summary
+    ticks = [p["tick"] for p in processes.values() if p.get("tick") is not None]
+    wms = [p["watermark"] for p in processes.values() if p.get("watermark") is not None]
+    merged_sinks: dict[str, list] = {}
+    for p in processes.values():
+        for label, snap in (p.get("sink_latency") or {}).items():
+            merged_sinks.setdefault(label, []).append(snap)
+    return {
+        "processes": processes,
+        "n_reporting": len(processes),
+        "tick_min": min(ticks) if ticks else None,
+        "tick_max": max(ticks) if ticks else None,
+        "watermark_min": min(wms) if wms else None,
+        "backlog_rows": sum(p.get("backlog_rows") or 0 for p in processes.values()),
+        "sink_latency": {
+            label: _metrics.Histogram.merge(snaps)
+            for label, snaps in sorted(merged_sinks.items())
+        },
+    }

@@ -46,11 +46,15 @@ class MicrobatchDispatcher:
         max_batch: int = 1024,
         min_bucket: int = _MIN_BUCKET,
         pad_item: Any = None,
+        label: str | None = None,
     ):
         self.fn = fn
         self.max_batch = max_batch
         self.min_bucket = min_bucket
         self.pad_item = pad_item
+        # span label for the live trace plane (e.g. the UDF name); dispatch
+        # spans are suppressed when unset or tracing is off
+        self.label = label
         self._items: list = []
 
     def __len__(self) -> int:
@@ -65,6 +69,13 @@ class MicrobatchDispatcher:
         (zero padding waste) and leaves the remainder buffered — the cross-tick
         accumulation mode: the engine keeps feeding rows and flushes the tail
         on its autocommit deadline."""
+        from pathway_tpu import observability as _obs
+
+        tracer = _obs.current() if self.label is not None else None
+        if tracer is not None and tracer.tick_span_id is None:
+            # head sampling: an unsampled tick records NO spans — dispatches
+            # included (same gate as MicrobatchApplyNode's launch span)
+            tracer = None
         out: list = []
         while self._items and (not only_full or len(self._items) >= self.max_batch):
             chunk = self._items[: self.max_batch]
@@ -73,7 +84,26 @@ class MicrobatchDispatcher:
             b = bucket_size(n, self.min_bucket, self.max_batch)
             pad = chunk[-1] if self.pad_item is None else self.pad_item
             padded = chunk + [pad] * (b - n)
-            results = self.fn(padded)
+            if tracer is not None:
+                import time as _t
+
+                w0 = _t.time_ns()
+                results = self.fn(padded)
+                tracer.span(
+                    "device/dispatch",
+                    w0,
+                    _t.time_ns(),
+                    **{
+                        "pathway.udf": self.label,
+                        "pathway.bucket": b,
+                        "pathway.rows": n,
+                        # first sight of this padded shape = fresh jit
+                        # compile-cache entry on this process
+                        "pathway.cold_shape": tracer.first_shape(self.label, b),
+                    },
+                )
+            else:
+                results = self.fn(padded)
             if len(results) != b:
                 raise ValueError(
                     f"microbatch fn returned {len(results)} results for batch of {b}"

@@ -442,6 +442,9 @@ class ClusterRuntime:
         self._stop_requested = False
         self.streaming = False  # set after build (see engine.runtime.Runtime)
         self.current_time = 0
+        # live tracing (observability): installed in run(), None when off
+        self.tracer = None
+        self._trace_active = False
         self.local_workers: dict[int, _LocalWorker] = {}
         # intra-process rows ride the local mesh; cross-process rows take the
         # TCP links (the ICI/DCN split — see parallel/device_plane.py)
@@ -560,13 +563,28 @@ class ClusterRuntime:
     # ---------------------------------------------------------------- ticking
     def _sweep_worker(self, lw: _LocalWorker, time: int) -> bool:
         any_work = False
+        trace = self._trace_active
         for node in lw.graph.nodes:
             with lw.lock:
                 if not node.has_pending():
                     continue
                 inputs = node.drain()
-            node.stats_rows_in += sum(len(b) for b in inputs if b is not None)
+            rows_in = sum(len(b) for b in inputs if b is not None)
+            node.stats_rows_in += rows_in
+            if trace:
+                w0 = _time.time_ns()
             out = run_annotated(node, node.process, inputs, time)
+            if trace:
+                self.tracer.span(
+                    f"sweep/{node.name}",
+                    w0,
+                    _time.time_ns(),
+                    {
+                        "pathway.operator.id": node.node_index,
+                        "pathway.worker": lw.index,
+                        "pathway.rows_in": rows_in,
+                    },
+                )
             self._route(lw, node, out)
             any_work = True
         return any_work
@@ -597,9 +615,25 @@ class ClusterRuntime:
 
     def _barrier(self, report: Any, decide) -> Any:
         _faults.before_barrier(self.pid, self.current_time)
+        if not self._trace_active:
+            if self.pid == 0:
+                return self.coord.barrier(report, decide)
+            return self.client.barrier(report)
+        # sampled tick: record the barrier round as a child span — wait time
+        # at barriers IS the cluster's skew/critical-path signal (SnailTrail)
+        phase = report[0] if isinstance(report, tuple) and report else "barrier"
+        w0 = _time.time_ns()
         if self.pid == 0:
-            return self.coord.barrier(report, decide)
-        return self.client.barrier(report)
+            decision = self.coord.barrier(report, decide)
+        else:
+            decision = self.client.barrier(report)
+        self.tracer.span(
+            f"cluster/barrier/{phase}",
+            w0,
+            _time.time_ns(),
+            {"pathway.process_id": self.pid, "pathway.tick": self.current_time},
+        )
+        return decision
 
     def _round_until_quiescent(self, time: int, phase: str) -> None:
         """Sweep-report rounds until globally quiescent (no work anywhere and
@@ -673,6 +707,9 @@ class ClusterRuntime:
 
     def run_tick(self, time: int, skip_poll: bool = False) -> None:
         self.current_time = time
+        tracer = self.tracer
+        tick_token = tracer.begin_tick(time) if tracer is not None else None
+        self._trace_active = tick_token is not None
         if self.hb_client is not None:
             self.hb_client.tick = time
         # non-partitioned sources poll on global worker 0 only; partitioned
@@ -711,10 +748,28 @@ class ClusterRuntime:
                 run_annotated(node, node.on_tick_complete, time)
         for cb in self.on_tick_done:
             cb(time)
+        if tick_token is not None:
+            self._trace_active = False
+            tracer.end_tick(time, tick_token)
 
     # ---------------------------------------------------------------- run loop
     def run(self, outputs: list[LogicalNode]):
+        from pathway_tpu import observability as _obs
+
         _faults.install_from_env()
+        _obs.install_from_env(self)
+        self.tracer = _obs.current()
+        if self.hb_client is not None:
+            # telemetry summaries ride the existing heartbeat messages, so the
+            # coordinator's /status can show this peer's tick/watermark/backlog
+            self.hb_client.summary_fn = lambda: _obs.aggregate.local_summary(self)
+        try:
+            return self._run_inner(outputs)
+        finally:
+            self.tracer = None
+            _obs.shutdown()
+
+    def _run_inner(self, outputs: list[LogicalNode]):
         self._build(outputs)
         self.streaming = bool(self.connectors)
         if self.pid == 0:

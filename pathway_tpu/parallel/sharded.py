@@ -78,6 +78,9 @@ class ShardedRuntime:
         self.streaming = False  # set after build (see engine.runtime.Runtime)
         self.current_time = 0
         self.on_tick_done: list[Any] = []
+        # live tracing (observability): installed in run(), None when off
+        self.tracer = None
+        self._trace_active = False
         # on-device all_to_all exchange for numeric blocks (None = host-only;
         # see parallel/device_plane.py and PATHWAY_DEVICE_EXCHANGE)
         from pathway_tpu.parallel.device_plane import make_device_plane
@@ -168,14 +171,31 @@ class ShardedRuntime:
 
     # ---------------------------------------------------------------- ticking
     def _sweep_worker(self, worker: _Worker, time: int) -> bool:
+        import time as _t
+
         any_work = False
+        trace = self._trace_active
         for node in worker.graph.nodes:
             with worker.lock:
                 if not node.has_pending():
                     continue
                 inputs = node.drain()
-            node.stats_rows_in += sum(len(b) for b in inputs if b is not None)
+            rows_in = sum(len(b) for b in inputs if b is not None)
+            node.stats_rows_in += rows_in
+            if trace:
+                w0 = _t.time_ns()
             out = run_annotated(node, node.process, inputs, time)
+            if trace:
+                self.tracer.span(
+                    f"sweep/{node.name}",
+                    w0,
+                    _t.time_ns(),
+                    {
+                        "pathway.operator.id": node.node_index,
+                        "pathway.worker": worker.index,
+                        "pathway.rows_in": rows_in,
+                    },
+                )
             if self._route(worker, node, out):
                 any_work = True
             any_work = any_work or any(b is not None for b in inputs)
@@ -227,6 +247,9 @@ class ShardedRuntime:
 
     def run_tick(self, time: int) -> None:
         self.current_time = time
+        tracer = self.tracer
+        tick_token = tracer.begin_tick(time) if tracer is not None else None
+        self._trace_active = tick_token is not None
         # non-partitioned sources live on worker 0 only — peers' copies never
         # poll (polling them would duplicate every input row per worker);
         # partitioned sources (``local_source``) poll on their OWN worker,
@@ -257,9 +280,25 @@ class ShardedRuntime:
                 run_annotated(node, node.on_tick_complete, time)
         for cb in self.on_tick_done:
             cb(time)
+        if tick_token is not None:
+            self._trace_active = False
+            tracer.end_tick(time, tick_token)
 
     # ---------------------------------------------------------------- run loop
     def run(self, outputs: list[LogicalNode]):
+        import time as _time
+
+        from pathway_tpu import observability as _obs
+
+        _obs.install_from_env(self)
+        try:
+            self.tracer = _obs.current()
+            return self._run_inner(outputs)
+        finally:
+            self.tracer = None
+            _obs.shutdown()
+
+    def _run_inner(self, outputs: list[LogicalNode]):
         import time as _time
 
         self._build(outputs)

@@ -390,6 +390,18 @@ class _EpochLog:
             opsnap_gen=opsnap_gen if opsnap_gen is not None else -1,
             n_inputs=len(offsets),
         )
+        from pathway_tpu import observability as _obs
+
+        tracer = _obs.current()
+        if tracer is not None:
+            tracer.event(
+                "persist/epoch_commit",
+                **{
+                    "pathway.epoch": self.epoch,
+                    "pathway.tick": tick,
+                    "pathway.n_inputs": len(offsets),
+                },
+            )
         return True
 
 

@@ -58,13 +58,16 @@ def _recv(sock: socket.socket):
 
 
 class _PeerState:
-    __slots__ = ("last_seen", "tick", "eof", "clean")
+    __slots__ = ("last_seen", "tick", "eof", "clean", "summary")
 
     def __init__(self) -> None:
         self.last_seen = _time.monotonic()
         self.tick: int | None = None
         self.eof = False
         self.clean = False
+        # latest telemetry summary shipped on the heartbeat (observability
+        # plane: tick/watermark/backlog/sink-latency) — None until one arrives
+        self.summary: dict | None = None
 
 
 class HeartbeatMonitor:
@@ -107,7 +110,10 @@ class HeartbeatMonitor:
                 msg = _recv(conn)
                 if msg is None:
                     break  # EOF
-                kind, peer, tick = msg
+                # 3-tuple = bare heartbeat; 4-tuple appends the telemetry
+                # summary (observability plane) — both generations accepted
+                kind, peer, tick = msg[0], msg[1], msg[2]
+                summary = msg[3] if len(msg) > 3 else None
                 if pid is None:
                     pid = int(peer)
                     with self._lock:
@@ -117,6 +123,8 @@ class HeartbeatMonitor:
                     st.last_seen = _time.monotonic()
                     if tick is not None:
                         st.tick = int(tick)
+                    if summary is not None:
+                        st.summary = summary
                     if kind == "bye":
                         st.clean = True
                 if kind == "bye":
@@ -138,6 +146,13 @@ class HeartbeatMonitor:
         """pid → last-known tick, for every peer that ever connected."""
         with self._lock:
             return {pid: st.tick for pid, st in self._peers.items()}
+
+    def peer_summaries(self) -> dict[int, dict | None]:
+        """pid → latest telemetry summary shipped on that peer's heartbeats
+        (None for peers that never sent one) — the coordinator's /status
+        cluster section reads this."""
+        with self._lock:
+            return {pid: st.summary for pid, st in self._peers.items()}
 
     def dead_peer(self) -> tuple[int, int | None, str] | None:
         """(pid, last_tick, reason) of a failed peer, else None. EOF beats a
@@ -196,6 +211,9 @@ class HeartbeatClient:
         self.interval = interval
         self.tick = 0
         self.coordinator_lost = False
+        # optional telemetry provider (observability plane): when set, each
+        # heartbeat carries its summary so process 0 aggregates the cluster
+        self.summary_fn = None
         self._closed = False
         self._sock: socket.socket | None = None
         self._host = host
@@ -217,8 +235,15 @@ class HeartbeatClient:
                     return  # no monitor (e.g. heartbeats disabled on pid 0)
                 _time.sleep(0.05)
         while not self._closed:
+            summary = None
+            fn = self.summary_fn
+            if fn is not None:
+                try:
+                    summary = fn()
+                except Exception:
+                    summary = None  # telemetry must never kill the heartbeat
             try:
-                _send(self._sock, ("hb", self.pid, self.tick))
+                _send(self._sock, ("hb", self.pid, self.tick, summary))
             except OSError:
                 if not self._closed:
                     self.coordinator_lost = True
