@@ -1,0 +1,169 @@
+"""Flow-control plane overhead benchmark (ISSUE r9 acceptance gate).
+
+Measures streaming throughput of an identical live-connector pipeline
+(ConnectorSubject pushing batches → with_columns → groupby → subscribe):
+
+- ``flow_off``        — ``PATHWAY_FLOW=off`` (default): no gates installed,
+  push/poll pay one ``is None`` test. The r8-equivalent baseline.
+- ``flow_on``         — ``PATHWAY_FLOW=on`` with a queue bound far above the
+  working set: NO pressure ever develops, so the measurement isolates the
+  plane's bookkeeping (credit accounting per push chunk, one controller step
+  + admission plan per tick).
+- ``flow_on_bounded`` — informational: a bound equal to one tick's batch,
+  demonstrating real backpressure (the producer blocks on credit); peak
+  queue occupancy is reported and asserted ≤ the bound.
+
+The producer is LOCKSTEPPED to the tick loop (it pushes one fixed-size batch,
+then waits for that tick's ``on_time_end``), so every mode processes the
+identical sequence of delta blocks — the comparison isolates the plane's
+bookkeeping (credit accounting per push chunk, one controller step +
+admission plan per tick) from arrival-timing noise, which otherwise swamps
+the signal on shared hosts.
+
+Gate: ``flow_on`` (no pressure) must stay within 5% of ``flow_off`` median
+throughput — exit 1 otherwise. ``flow_on_bounded`` is exempt from the
+throughput gate (blocking the producer IS the feature) but must respect its
+bound.
+
+Run: ``python benchmarks/flowcontrol_bench.py [N_EVENTS]``. Prints one JSON
+line (written to BENCH_r09.json by CI).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+PUSH_ROWS = 4096  # rows pushed per tick (in 512-row credit chunks)
+CHUNK_ROWS = 512
+REPS = 5
+BOUNDED_QUEUE = 4096
+
+
+def _run_once(n_events: int, track_peak: bool = False) -> tuple[float, int]:
+    """One live streaming run; returns (rows/s, peak queued+in-flight rows)."""
+    import threading
+
+    import pathway_tpu as pw
+    from pathway_tpu import flow as _flow
+    from pathway_tpu.internals.parse_graph import G
+
+    tick_done = threading.Event()
+    tick_done.set()  # first batch goes out immediately
+
+    class Subj(pw.io.python.ConnectorSubject):
+        def run(self):
+            for start in range(0, n_events, PUSH_ROWS):
+                tick_done.wait(timeout=5.0)
+                tick_done.clear()
+                for c in range(start, min(start + PUSH_ROWS, n_events), CHUNK_ROWS):
+                    self.next_batch(
+                        [{"x": i} for i in range(c, min(c + CHUNK_ROWS, n_events))]
+                    )
+
+    peak = 0
+
+    G.clear()
+    t = pw.io.python.read(Subj(), schema=pw.schema_from_types(x=int))
+    t = t.with_columns(m=t.x % 7)
+    g = t.groupby(t.m).reduce(s=pw.reducers.sum(t.x), c=pw.reducers.count())
+    seen = []
+
+    def on_change(**k):
+        seen.append(1)
+        if track_peak:
+            nonlocal peak
+            plane = _flow.current()
+            if plane is not None:
+                for gate in plane.gates:
+                    peak = max(peak, gate.queued + gate.in_flight)
+
+    def on_time_end(time_):
+        tick_done.set()  # lockstep: release the next tick's batch
+
+    pw.io.subscribe(g, on_change=on_change, on_time_end=on_time_end)
+    t0 = time.perf_counter()
+    pw.run(monitoring_level="none", autocommit_duration_ms=1)
+    elapsed = time.perf_counter() - t0
+    assert seen, "pipeline produced no output"
+    return n_events / elapsed, peak
+
+
+def _set_mode(mode: str, n_events: int) -> None:
+    os.environ.pop("PATHWAY_FLOW", None)
+    os.environ.pop("PATHWAY_INPUT_QUEUE_ROWS", None)
+    os.environ.pop("PATHWAY_FLOW_POLICY", None)
+    if mode == "flow_off":
+        os.environ["PATHWAY_FLOW"] = "off"
+    elif mode == "flow_on":
+        os.environ["PATHWAY_FLOW"] = "on"
+        # bound far above the working set: pure bookkeeping, zero pressure
+        os.environ["PATHWAY_INPUT_QUEUE_ROWS"] = str(max(n_events * 2, 1_000_000))
+    elif mode == "flow_on_bounded":
+        os.environ["PATHWAY_FLOW"] = "on"
+        os.environ["PATHWAY_INPUT_QUEUE_ROWS"] = str(BOUNDED_QUEUE)
+    else:
+        raise ValueError(mode)
+
+
+def main() -> int:
+    import statistics
+
+    n_events = int(sys.argv[1]) if len(sys.argv) > 1 else 200_000
+    _set_mode("flow_off", n_events)
+    _run_once(min(n_events, 10_000))  # warmup (imports, first-run paths)
+
+    modes = ("flow_off", "flow_on", "flow_on_bounded")
+    # interleave reps across modes so shared-host drift cancels; the lockstep
+    # producer makes block structure identical, so the MEDIAN is stable
+    rates: dict[str, list[float]] = {m: [] for m in modes}
+    peaks: list[int] = []
+    for _ in range(REPS):
+        for mode in modes:
+            _set_mode(mode, n_events)
+            rate, peak = _run_once(n_events, track_peak=(mode == "flow_on_bounded"))
+            rates[mode].append(rate)
+            if mode == "flow_on_bounded":
+                peaks.append(peak)
+    results: dict = {
+        "bench": "flowcontrol_overhead",
+        "n_events": n_events,
+        "push_rows": PUSH_ROWS,
+        "reps": REPS,
+        "bounded_queue_rows": BOUNDED_QUEUE,
+    }
+    for mode in modes:
+        results[f"{mode}_rows_per_s"] = round(statistics.median(rates[mode]), 1)
+        results[f"{mode}_rows_per_s_all"] = [round(r, 1) for r in rates[mode]]
+    off = results["flow_off_rows_per_s"]
+    results["flow_on_overhead_pct"] = round(
+        100.0 * (1 - results["flow_on_rows_per_s"] / off), 2
+    )
+    results["bounded_peak_queued_rows"] = max(peaks) if peaks else 0
+    bound_ok = results["bounded_peak_queued_rows"] <= BOUNDED_QUEUE
+    overhead_ok = results["flow_on_overhead_pct"] <= 5.0
+    results["within_budget"] = bool(overhead_ok and bound_ok)
+    print(json.dumps(results))
+    if not overhead_ok:
+        print(
+            f"FAIL: flow plane overhead {results['flow_on_overhead_pct']}% "
+            f"exceeds the 5% budget with no pressure",
+            file=sys.stderr,
+        )
+        return 1
+    if not bound_ok:
+        print(
+            f"FAIL: peak queue {results['bounded_peak_queued_rows']} rows "
+            f"exceeds the {BOUNDED_QUEUE}-row bound",
+            file=sys.stderr,
+        )
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -65,15 +65,22 @@ class Runtime:
         self._stop_requested = True
 
     def run(self, outputs: list[LogicalNode]) -> Scheduler:
+        from pathway_tpu import flow as _flow
         from pathway_tpu import observability as _obs
         from pathway_tpu.resilience import faults as _faults
 
         _faults.install_from_env()
         _obs.install_from_env(self)
+        # flow plane before the graph builds: ingest gates attach as the
+        # StreamInputNodes are constructed
+        _flow.install_from_env(self)
         try:
             return self._run(outputs, _obs.current())
         finally:
             _obs.shutdown()
+            # closing the gates wakes producers blocked on credit, so
+            # connector threads can exit even after a failed run
+            _flow.shutdown()
 
     def _run(self, outputs: list[LogicalNode], tracer) -> Scheduler:
         from pathway_tpu.resilience import faults as _faults
@@ -89,6 +96,16 @@ class Runtime:
             # rewind to sentinel, then seek, src/connectors/mod.rs:100-105)
             self.persistence.on_graph_built(ctx)
             scheduler.on_tick_done.append(self.persistence.on_tick_done)
+
+        from pathway_tpu import flow as _flow
+
+        plane = _flow.current()
+        if plane is not None:
+            # after the tick settles: replenish ingest credits, step the AIMD
+            # controller, plan the next tick's admission budgets
+            scheduler.on_tick_done.append(
+                lambda t: plane.on_tick_complete(self, t)
+            )
 
         for driver in self.connectors:
             driver.start()

@@ -1,0 +1,69 @@
+"""Priority admission: interactive vs bulk service classes.
+
+The input plane carries two kinds of traffic with opposite objectives: RAG
+*query* streams want bounded end-to-end latency (the ``PATHWAY_LATENCY_SLO_MS``
+deadline), *backfill*/bulk-ingest streams want throughput and tolerate delay.
+Before r9 both shared one FIFO path — a backfill burst ahead of a query in the
+connector queue added its entire drain time to the query's latency.
+
+This scheduler separates them at **tick granularity**: every tick, interactive
+inputs drain fully (their rows always make the next tick — queries overtake),
+while each bulk input's drain is capped by a budget derived from the current
+pressure signal (the AIMD controller's blend of sink-latency-vs-SLO and queue
+occupancy). Under no pressure bulk drains fully too — zero cost; under full
+pressure bulk degrades to ``PATHWAY_FLOW_BULK_MIN_ROWS`` per tick, so backfill
+keeps progressing (never starved) instead of being paused. Budgeted rows left
+in the queue keep holding their credits — they still occupy producer memory,
+so admission never un-bounds the queue.
+
+Deadline-awareness lives in the pressure signal: the controller scales it by
+how close the recent interactive sink p99 sits to the SLO (DS2-style measured
+feedback, Kalavri et al., OSDI '18), so bulk throttling engages *before* the
+deadline is broken, proportionally to how endangered it is.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+INTERACTIVE = "interactive"
+BULK = "bulk"
+
+SERVICE_CLASSES = (INTERACTIVE, BULK)
+
+#: below this pressure bulk traffic is not throttled at all (hysteresis floor:
+#: an idle pipeline pays nothing for having the plane on)
+_PRESSURE_FLOOR = 0.25
+
+
+def validate_service_class(service_class: str) -> str:
+    sc = str(service_class).strip().lower()
+    if sc not in SERVICE_CLASSES:
+        raise ValueError(
+            f"service_class must be one of {SERVICE_CLASSES}, got {service_class!r}"
+        )
+    return sc
+
+
+class AdmissionScheduler:
+    """Writes per-tick admission budgets onto the gates."""
+
+    def __init__(self, bulk_min_rows: int):
+        self.bulk_min_rows = max(1, int(bulk_min_rows))
+
+    def plan(self, gates: list[Any], pressure: float) -> None:
+        """Set each gate's budget for the NEXT tick from the current pressure
+        in [0, 1]. Interactive gates are never budgeted."""
+        for gate in gates:
+            if getattr(gate.node, "service_class", INTERACTIVE) != BULK:
+                gate.budget = None
+                continue
+            if pressure <= _PRESSURE_FLOOR:
+                gate.budget = None
+                continue
+            # linear back-off from a full queue's worth of admission down to
+            # the guaranteed minimum at pressure >= 1
+            frac = max(0.0, 1.0 - min(1.0, pressure))
+            gate.budget = max(
+                self.bulk_min_rows, int(gate.effective_bound() * frac)
+            )

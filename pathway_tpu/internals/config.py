@@ -174,6 +174,60 @@ class PathwayConfig:
         raw = os.environ.get("PATHWAY_MICROBATCH_FLUSH_MS")
         return None if raw in (None, "") else float(raw)
 
+    # ---- flow control (adaptive admission plane) ----------------------------
+    @property
+    def flow(self) -> str:
+        """Adaptive flow-control plane master switch: ``off`` (default — no
+        gates installed, ingest queues unbounded, byte-for-byte the pre-r9
+        behavior) or ``on`` (bounded credit queues on every connector input,
+        priority admission for interactive vs bulk service classes, and the
+        AIMD microbatch controller)."""
+        raw = os.environ.get("PATHWAY_FLOW", "off").strip().lower()
+        if raw in ("", "0", "false", "no", "off"):
+            return "off"
+        if raw in ("1", "true", "yes", "on"):
+            return "on"
+        raise ValueError(f"PATHWAY_FLOW must be off/on, got {raw!r}")
+
+    @property
+    def input_queue_rows(self) -> int:
+        """Per-connector ingest queue bound (rows) when the flow plane is on.
+        Credits are consumed by connector pushes and replenished when the tick
+        that drained the rows completes downstream."""
+        n = _env_int("PATHWAY_INPUT_QUEUE_ROWS", 65536)
+        if n < 1:
+            raise ValueError(f"PATHWAY_INPUT_QUEUE_ROWS must be >= 1, got {n}")
+        return n
+
+    @property
+    def flow_policy(self) -> str:
+        """Overflow policy of a full ingest queue: ``block`` (default — the
+        producer thread waits for credit, classic backpressure) or ``shed``
+        (overflow rows are dropped and counted — explicit, telemetry-visible
+        load shedding instead of silent memory growth)."""
+        raw = os.environ.get("PATHWAY_FLOW_POLICY", "block").strip().lower()
+        if raw not in ("block", "shed"):
+            raise ValueError(f"PATHWAY_FLOW_POLICY must be block/shed, got {raw!r}")
+        return raw
+
+    @property
+    def latency_slo_ms(self) -> float:
+        """Interactive sink end-to-end latency objective (ms). The AIMD
+        controller halves the microbatch target bucket when the recent sink
+        p99 exceeds this, and the admission scheduler throttles bulk-class
+        inputs as the observed latency approaches it."""
+        v = _env_float("PATHWAY_LATENCY_SLO_MS", 250.0)
+        if v <= 0:
+            raise ValueError(f"PATHWAY_LATENCY_SLO_MS must be > 0, got {v}")
+        return v
+
+    @property
+    def flow_bulk_min_rows(self) -> int:
+        """Guaranteed bulk-class admission per tick under full pressure —
+        backfill keeps progressing (never starved) while interactive traffic
+        overtakes it."""
+        return max(1, _env_int("PATHWAY_FLOW_BULK_MIN_ROWS", 64))
+
     @property
     def monitoring_server(self) -> str | None:
         return os.environ.get("PATHWAY_MONITORING_SERVER")
@@ -261,6 +315,11 @@ class PathwayConfig:
                 "continue_after_replay",
                 "terminate_on_error",
                 "runtime_typechecking",
+                "flow",
+                "flow_policy",
+                "flow_bulk_min_rows",
+                "input_queue_rows",
+                "latency_slo_ms",
                 "monitoring_server",
                 "run_id",
             )

@@ -591,13 +591,18 @@ class Table:
         on_change: Callable | None = None,
         on_time_end: Callable | None = None,
         on_end: Callable | None = None,
+        service_class: str = "interactive",
     ) -> LogicalNode:
         cols = self.column_names()
-        node = LogicalNode(
-            lambda: ops.SubscribeNode(cols, on_change, on_time_end, on_end),
-            [self._node],
-            name="subscribe",
-        )
+
+        def factory() -> ops.SubscribeNode:
+            n = ops.SubscribeNode(cols, on_change, on_time_end, on_end)
+            # flow plane SLO scope: the AIMD controller watches only
+            # interactive-class sinks' latency histograms
+            n.service_class = service_class
+            return n
+
+        node = LogicalNode(factory, [self._node], name="subscribe")
         return node
 
     # static constructors ------------------------------------------------------

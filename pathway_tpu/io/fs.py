@@ -72,6 +72,7 @@ def read(
     autocommit_duration_ms: int | None = None,
     with_metadata: bool = False,
     name: str | None = None,
+    service_class: str = "bulk",
     **kwargs: Any,
 ) -> Table:
     if schema is None:
@@ -127,7 +128,15 @@ def read(
         def on_stop(self) -> None:
             self._stop = True
 
-    return py_read(_FsSubject(), schema=schema, name=name or f"fs:{path}")
+    # directory ingestion is the canonical backfill workload: default to the
+    # flow plane's bulk class so interactive query streams overtake a document
+    # re-scan at tick granularity (pass service_class="interactive" to opt out)
+    return py_read(
+        _FsSubject(),
+        schema=schema,
+        name=name or f"fs:{path}",
+        service_class=service_class,
+    )
 
 
 def _metadata_for(fpath: str) -> Any:
@@ -150,6 +159,7 @@ def write(
     *,
     format: str = "csv",  # noqa: A002
     sharded: bool = False,
+    service_class: str = "interactive",
     **kwargs: Any,
 ) -> None:
     """Append output diffs to a file with time/diff columns (reference FileWriter +
@@ -160,9 +170,18 @@ def write(
     into ``filename`` ordered by logical time (ties broken by worker index) and
     the parts are removed. Under a multi-process cluster the parts remain on
     disk per process (no cross-process close ordering) — consume them as a
-    part-file set, Spark-style."""
+    part-file set, Spark-style.
+
+    ``service_class="bulk"`` excludes this writer's end-to-end latency from
+    the flow plane's SLO (an fsync-bound audit mirror must not drag the AIMD
+    microbatch bucket down)."""
+    from pathway_tpu.flow import validate_service_class
+
+    service_class = validate_service_class(service_class)
     if sharded:
-        return _write_sharded(table, filename, format=format, **kwargs)
+        return _write_sharded(
+            table, filename, format=format, service_class=service_class, **kwargs
+        )
     parent = os.path.dirname(os.path.abspath(filename))
     if not os.path.isdir(parent):
         # fail at graph build like the eager-open era did, not mid-run
@@ -252,6 +271,7 @@ def write(
             on_done if owner else None,
             sink_state=sink_state if owner else None,
             restore_sink=restore_sink if owner else None,
+            service_class=service_class,
         )
 
     LogicalNode(factory, [table._node], name=f"fs_write:{filename}")._register_as_output()
@@ -291,7 +311,14 @@ def _row_formatter(format: str, cols: list[str]):  # noqa: A002
     raise ValueError(f"unknown format {format!r}")
 
 
-def _write_sharded(table: Table, filename: str, *, format: str, **kwargs: Any) -> None:  # noqa: A002
+def _write_sharded(
+    table: Table,
+    filename: str,
+    *,
+    format: str,  # noqa: A002
+    service_class: str = "interactive",
+    **kwargs: Any,
+) -> None:
     """Per-worker sink shards + ordered merge-commit (VERDICT r4 #2).
 
     Persistence (ISSUE 2 satellite, ADVICE r5): part files get the same
@@ -474,6 +501,7 @@ def _write_sharded(table: Table, filename: str, *, format: str, **kwargs: Any) -
             sharded=True,
             sink_state=sink_state,
             restore_sink=restore_sink,
+            service_class=service_class,
         )
 
     LogicalNode(factory, [table._node], name=f"fs_write:{filename}")._register_as_output()

@@ -170,6 +170,7 @@ def _read_real(
     partitions: list[int] | None,
     poll_interval: float,
     name: str | None,
+    service_class: str = "interactive",
 ):
     """Consumer-driven read over the wire protocol client (reference
     ``KafkaReader``, ``src/connectors/data_storage.rs:712``): assigned
@@ -303,6 +304,7 @@ def _read_real(
         lambda w, n: _RealKafkaSubject(w, n),
         schema=schema,
         name=name or f"kafka:{topic}",
+        service_class=service_class,
     )
 
 
@@ -318,6 +320,7 @@ def read(
     poll_interval: float = 0.05,
     autocommit_duration_ms: int | None = None,
     name: str | None = None,
+    service_class: str = "interactive",
     **kwargs: Any,
 ) -> Table:
     """Consume ``topic`` into a table. ``mode="static"`` drains the current log
@@ -332,7 +335,8 @@ def read(
     the_parser = parser or parser_for(format, schema)
     if isinstance(broker, dict):
         return _read_real(
-            broker, topic, schema, the_parser, mode, partitions, poll_interval, name
+            broker, topic, schema, the_parser, mode, partitions, poll_interval, name,
+            service_class=service_class,
         )
 
     from pathway_tpu.io.python import (
@@ -417,6 +421,7 @@ def read(
         lambda w, n: _KafkaSubject(w, n),
         schema=schema,
         name=name or f"kafka:{topic}",
+        service_class=service_class,
     )
 
 

@@ -182,7 +182,13 @@ class _TimedInputNode(ops.StreamInputNode):
     columnarizes ONCE (numpy key/diff/time arrays + typed value columns) and
     every tick emits an array slice — no per-event Python in the run loop.
     When persistence hooks the node's push functions (input logging), the
-    per-event push path is kept so the log sees every event."""
+    per-event push path is kept so the log sees every event.
+
+    Not flow-gated: the fixture replays a deterministic pre-timed event list
+    (no live producer to backpressure), and gating it would perturb the exact
+    logical times the tests pin."""
+
+    flow_gated = False
 
     def __init__(self, events, columns, np_dtypes, upsert=False, arrays=None):
         super().__init__(columns, np_dtypes, upsert=upsert)
@@ -311,8 +317,15 @@ def read(
     autocommit_duration_ms: int | None = None,
     name: str | None = None,
     event_time_column: str | None = None,
+    service_class: str = "interactive",
     **kwargs: Any,
 ) -> Table:
+    from pathway_tpu.flow import validate_service_class
+
+    # flow plane (PATHWAY_FLOW=on): ``interactive`` streams always drain at
+    # tick start; ``bulk`` (backfill) streams are budget-throttled under
+    # pressure so query traffic overtakes them at tick granularity
+    service_class = validate_service_class(service_class)
     columns = schema.column_names()
     np_dtypes = schema.np_dtypes()
     subject._columns = columns
@@ -334,6 +347,7 @@ def read(
             node = _TimedInputNode(events, columns, np_dtypes, arrays=arrays)
             node.event_time_index = event_time_index
             node.input_name = name or "stream_fixture"
+            node.service_class = service_class
             holder["node"] = node
             return node
 
@@ -350,6 +364,7 @@ def read(
         )
         node.event_time_index = event_time_index
         node.input_name = name or getattr(subject, "datasource_name", None) or "python"
+        node.service_class = service_class
         subject._node = node
         return node
 
@@ -370,6 +385,7 @@ def read_partitioned(
     schema: schema_mod.SchemaMetaclass,
     autocommit_duration_ms: int | None = None,
     name: str | None = None,
+    service_class: str = "interactive",
 ) -> Table:
     """Partition-per-worker ingest (reference: Kafka read partition-per-worker,
     ``worker-architecture.md:36-47``; r5 kills the worker-0 SOLO pin).
@@ -381,8 +397,10 @@ def read_partitioned(
     normal key exchange. Under a single-worker runtime this degenerates to
     ``read(make_subject(0, 1), ...)``.
     """
+    from pathway_tpu.flow import validate_service_class
     from pathway_tpu.internals.logical import current_build
 
+    service_class = validate_service_class(service_class)
     columns = schema.column_names()
     np_dtypes = schema.np_dtypes()
 
@@ -398,6 +416,7 @@ def read_partitioned(
         )
         node.local_source = True  # poll on the owning worker, not worker 0
         node.source_worker = w
+        node.service_class = service_class
         subject._node = node
         if ctx is not None and ctx.register is not None:
             ctx.register(_SubjectDriver(subject))

@@ -38,7 +38,7 @@ def local_summary(runtime) -> dict[str, Any]:
                 backlog += len(getattr(node, "waiting", ()))
             if node.name in ("subscribe", "capture", "output"):
                 rows_out += node.stats_rows_in
-    return {
+    summary = {
         "tick": getattr(scheduler, "current_time", None),
         "watermark": _metrics.min_watermark(scheduler),
         "backlog_rows": backlog,
@@ -48,6 +48,14 @@ def local_summary(runtime) -> dict[str, Any]:
         "resilience": resilience_summary(),
         "ts_unix": round(_time.time(), 3),
     }
+    # flow plane: gate occupancy rides the heartbeat so the coordinator can
+    # merge a pod-wide pressure (credit piggyback — no new sockets)
+    from pathway_tpu import flow as _flow
+
+    plane = _flow.current()
+    if plane is not None:
+        summary["flow"] = plane.heartbeat_summary()
+    return summary
 
 
 def cluster_status(runtime) -> dict[str, Any] | None:
@@ -67,7 +75,7 @@ def cluster_status(runtime) -> dict[str, Any] | None:
     for p in processes.values():
         for label, snap in (p.get("sink_latency") or {}).items():
             merged_sinks.setdefault(label, []).append(snap)
-    return {
+    out = {
         "processes": processes,
         "n_reporting": len(processes),
         "tick_min": min(ticks) if ticks else None,
@@ -79,3 +87,12 @@ def cluster_status(runtime) -> dict[str, Any] | None:
             for label, snaps in sorted(merged_sinks.items())
         },
     }
+    flows = {pid: p.get("flow") for pid, p in processes.items() if p.get("flow")}
+    if flows:
+        out["flow"] = {
+            "shed_rows": sum(f.get("shed_rows") or 0 for f in flows.values()),
+            "occupied": sum(f.get("occupied") or 0 for f in flows.values()),
+            "bound": sum(f.get("bound") or 0 for f in flows.values()),
+            "pressure_max": max(f.get("pressure") or 0.0 for f in flows.values()),
+        }
+    return out

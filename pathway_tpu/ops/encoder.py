@@ -381,10 +381,15 @@ class HashTokenizer:
     def __call__(self, texts: list[str]) -> tuple[np.ndarray, np.ndarray]:
         # pad sequence length to a power-of-two bucket so jitted callers see a small
         # closed set of shapes (compile-cache discipline, ops/microbatch.py)
-        from pathway_tpu.ops.microbatch import bucket_size
+        from pathway_tpu.ops.microbatch import LENGTH_MAX_BUCKET, bucket_size
 
         cids, lens = self._tok_batch(texts)
-        L = min(self.max_len, bucket_size(int(lens.max(initial=0)) + 1, min_bucket=16))
+        L = min(
+            self.max_len,
+            bucket_size(
+                int(lens.max(initial=0)) + 1, min_bucket=16, max_bucket=LENGTH_MAX_BUCKET
+            ),
+        )
         n = len(texts)
         dtype = np.int16 if self.vocab_size <= 32768 else np.int32
         ids = np.zeros((n, L), dtype=dtype)
@@ -487,11 +492,15 @@ class WordPieceTokenizer:
 
     def __call__(self, texts: list) -> tuple:
         toks = [[self.cls_id] + self._tok(t) + [self.sep_id] for t in texts]
-        from pathway_tpu.ops.microbatch import bucket_size
+        from pathway_tpu.ops.microbatch import LENGTH_MAX_BUCKET, bucket_size
 
         L = min(
             self.max_len,
-            bucket_size(max((len(t) for t in toks), default=1), min_bucket=16),
+            bucket_size(
+                max((len(t) for t in toks), default=1),
+                min_bucket=16,
+                max_bucket=LENGTH_MAX_BUCKET,
+            ),
         )
         ids = np.zeros((len(toks), L), dtype=np.int32)
         mask = np.zeros((len(toks), L), dtype=bool)

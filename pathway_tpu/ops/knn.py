@@ -430,13 +430,13 @@ class BruteForceKnnIndex:
             # size — unpadded, every size would compile its own kernel);
             # padding repeats the last (slot, row) pair: duplicate writes of an
             # identical value are harmless
-            from pathway_tpu.ops.microbatch import bucket_size
+            from pathway_tpu.ops.microbatch import LENGTH_MAX_BUCKET, bucket_size
 
             # bits were captured at staging time: a key may have been removed
             # since (its slot gets invalidated separately)
             bits = np.asarray(self._pending_bits, dtype=np.uint32)
             m = len(slot_arr)
-            bucket = bucket_size(m, min_bucket=32)
+            bucket = bucket_size(m, min_bucket=32, max_bucket=LENGTH_MAX_BUCKET)
             if bucket > m:
                 pad = bucket - m
                 slot_arr = np.concatenate([slot_arr, np.repeat(slot_arr[-1:], pad)])

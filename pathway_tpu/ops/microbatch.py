@@ -23,9 +23,24 @@ import numpy as np
 
 _MIN_BUCKET = 8
 
+#: sequence-LENGTH bucketing cap (token-id padding in the encoder/reranker and
+#: scatter-block padding in knn): deliberately NOT the row-batch knob —
+#: ``PATHWAY_MICROBATCH_MAX_BATCH`` caps how many ROWS launch together, while a
+#: single row's padded token length may legitimately exceed it
+LENGTH_MAX_BUCKET = 4096
 
-def bucket_size(n: int, min_bucket: int = _MIN_BUCKET, max_bucket: int = 4096) -> int:
-    """Smallest power-of-two ≥ n (clamped) — the padded batch shape."""
+
+def bucket_size(n: int, min_bucket: int = _MIN_BUCKET, max_bucket: int | None = None) -> int:
+    """Smallest power-of-two ≥ n (clamped) — the padded batch shape.
+
+    ``max_bucket=None`` (the default) resolves to ``PATHWAY_MICROBATCH_MAX_BATCH``
+    so the knob actually caps row-batch launch shapes (it was a hardcoded 4096
+    before r9, letting >knob flushes launch oversized buckets); length-bucketing
+    callers pass :data:`LENGTH_MAX_BUCKET` explicitly."""
+    if max_bucket is None:
+        from pathway_tpu.internals.config import get_pathway_config
+
+        max_bucket = get_pathway_config().microbatch_max_batch
     b = min_bucket
     while b < n and b < max_bucket:
         b *= 2
@@ -43,11 +58,18 @@ class MicrobatchDispatcher:
     def __init__(
         self,
         fn: Callable[[list], Sequence],
-        max_batch: int = 1024,
+        max_batch: int | None = None,
         min_bucket: int = _MIN_BUCKET,
         pad_item: Any = None,
         label: str | None = None,
     ):
+        if max_batch is None:
+            # align the default launch chunk with the knob (it was a hardcoded
+            # 1024, so PATHWAY_MICROBATCH_MAX_BATCH silently didn't cap ad-hoc
+            # dispatchers)
+            from pathway_tpu.internals.config import get_pathway_config
+
+            max_batch = get_pathway_config().microbatch_max_batch
         self.fn = fn
         self.max_batch = max_batch
         self.min_bucket = min_bucket
@@ -125,7 +147,7 @@ def pad_ragged_2d(
     the shape discipline for token-id batches entering jitted models."""
     n = len(rows)
     max_len = max((len(r) for r in rows), default=1)
-    L = bucket_len or bucket_size(max_len, min_bucket=16)
+    L = bucket_len or bucket_size(max_len, min_bucket=16, max_bucket=LENGTH_MAX_BUCKET)
     out = np.full((n, L), fill, dtype=np.asarray(rows[0]).dtype if rows else np.int32)
     mask = np.zeros((n, L), dtype=bool)
     for i, r in enumerate(rows):

@@ -23,7 +23,7 @@ from pathway_tpu.ops.encoder import (
     encode,
     init_params,
 )
-from pathway_tpu.ops.microbatch import bucket_size
+from pathway_tpu.ops.microbatch import LENGTH_MAX_BUCKET, bucket_size
 
 _SEP = 2  # reserved token id used between query and doc
 
@@ -68,7 +68,12 @@ class JaxCrossEncoder:
             qt = qt[: budget // 2]
             dt = dt[: budget - len(qt)]
             texts_ids.append([1] + qt + [_SEP] + dt)
-        L = min(self.cfg.max_len, bucket_size(max(len(t) for t in texts_ids), min_bucket=16))
+        L = min(
+            self.cfg.max_len,
+            bucket_size(
+                max(len(t) for t in texts_ids), min_bucket=16, max_bucket=LENGTH_MAX_BUCKET
+            ),
+        )
         ids = np.zeros((len(pairs), L), dtype=np.int32)
         mask = np.zeros((len(pairs), L), dtype=bool)
         for i, t in enumerate(texts_ids):

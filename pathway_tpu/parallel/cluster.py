@@ -752,26 +752,42 @@ class ClusterRuntime:
             self._trace_active = False
             tracer.end_tick(time, tick_token)
 
+    def _peer_flows(self) -> dict[int, dict]:
+        """pid → flow-plane gate summary from each peer's heartbeats (empty
+        when failure detection is off — single-host pressure still applies)."""
+        if self.hb_monitor is None:
+            return {}
+        return self.hb_monitor.peer_flow()
+
     # ---------------------------------------------------------------- run loop
     def run(self, outputs: list[LogicalNode]):
+        from pathway_tpu import flow as _flow
         from pathway_tpu import observability as _obs
 
         _faults.install_from_env()
         _obs.install_from_env(self)
+        _flow.install_from_env(self)  # before build: gates attach to inputs
         self.tracer = _obs.current()
         if self.hb_client is not None:
             # telemetry summaries ride the existing heartbeat messages, so the
             # coordinator's /status can show this peer's tick/watermark/backlog
+            # (and, flow plane on, its gate occupancy for the credit merge)
             self.hb_client.summary_fn = lambda: _obs.aggregate.local_summary(self)
         try:
             return self._run_inner(outputs)
         finally:
             self.tracer = None
             _obs.shutdown()
+            _flow.shutdown()
 
     def _run_inner(self, outputs: list[LogicalNode]):
+        from pathway_tpu import flow as _flow
+
         self._build(outputs)
         self.streaming = bool(self.connectors)
+        plane = _flow.current()
+        if plane is not None:
+            self.on_tick_done.append(lambda t: plane.on_tick_complete(self, t))
         if self.pid == 0:
             self.coord.wait_connections()
         else:
@@ -814,16 +830,27 @@ class ClusterRuntime:
                     all_virtual = not self.connectors or all(
                         getattr(d, "virtual", False) for d in self.connectors
                     )
-                    decision = self.coord.barrier(
-                        report,
-                        lambda reports: {
+
+                    def decide(reports):
+                        d = {
                             "done": any(r[2] for r in reports)
                             or all(r[1] for r in reports)
-                        },
-                    )
+                        }
+                        if plane is not None:
+                            # cluster credit propagation: merge every peer's
+                            # heartbeat-piggybacked gate occupancy into one
+                            # pod-wide pressure and broadcast it with the
+                            # continue decision — a slow peer throttles every
+                            # producer instead of OOMing one host
+                            d["flow"] = plane.cluster_signal(self._peer_flows())
+                        return d
+
+                    decision = self.coord.barrier(report, decide)
                 else:
                     decision = self.client.barrier(report)
                     all_virtual = True
+                if plane is not None:
+                    plane.apply_cluster_signal(decision.get("flow"))
                 if decision["done"]:
                     self.run_tick(tick)  # drain final events
                     break

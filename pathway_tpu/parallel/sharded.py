@@ -288,15 +288,18 @@ class ShardedRuntime:
     def run(self, outputs: list[LogicalNode]):
         import time as _time
 
+        from pathway_tpu import flow as _flow
         from pathway_tpu import observability as _obs
 
         _obs.install_from_env(self)
+        _flow.install_from_env(self)  # before build: gates attach to inputs
         try:
             self.tracer = _obs.current()
             return self._run_inner(outputs)
         finally:
             self.tracer = None
             _obs.shutdown()
+            _flow.shutdown()
 
     def _run_inner(self, outputs: list[LogicalNode]):
         import time as _time
@@ -306,6 +309,12 @@ class ShardedRuntime:
         if self.persistence is not None:
             self.persistence.on_graph_built(self._ctx0)
             self.on_tick_done.append(self.persistence.on_tick_done)
+
+        from pathway_tpu import flow as _flow
+
+        plane = _flow.current()
+        if plane is not None:
+            self.on_tick_done.append(lambda t: plane.on_tick_complete(self, t))
         for driver in self.connectors:
             driver.start()
         if not self.connectors:

@@ -143,9 +143,19 @@ class _PersistedInput:
             if to_skip >= len(events):
                 to_skip -= len(events)
                 continue
-            for key, values, diff in events[to_skip:]:
-                self._original_push(key, values, diff)
-            replayed += len(events) - to_skip
+            tail = events[to_skip:]
+            append = getattr(self.node, "_append_events", None)
+            if append is not None:
+                # bulk append, BYPASSING the flow plane's credit gate: replay
+                # runs on the main thread before the tick loop starts, so a
+                # gated push would wait forever for tick-completion credits
+                # (block policy) or shed committed history (shed policy) —
+                # the log suffix already bounds replay memory
+                append([(int(k), v, d) for k, v, d in tail])
+            else:
+                for key, values, diff in tail:
+                    self._original_push(key, values, diff)
+            replayed += len(tail)
             to_skip = 0
         return replayed
 
@@ -216,6 +226,10 @@ class _PersistedInput:
 
         self.node.push = push  # type: ignore[method-assign]
         self.node.push_many = push_many  # type: ignore[method-assign]
+        # this log captures events before the flow plane's credit gate; the
+        # gate must stand down on logged nodes (offset-arithmetic + lock
+        # ordering — see StreamInputNode._push_gated)
+        self.node.flow_ungated = True
 
 
 class _OperatorSnapshots:

@@ -154,6 +154,18 @@ class HeartbeatMonitor:
         with self._lock:
             return {pid: st.summary for pid, st in self._peers.items()}
 
+    def peer_flow(self) -> dict[int, dict]:
+        """pid → the flow-plane credit/occupancy block piggybacked on that
+        peer's heartbeats ({} until one arrives). The coordinator merges these
+        into the pod-wide pressure it broadcasts on the tick barrier, which is
+        what makes backpressure CLUSTER-wide: a peer whose ingest queues fill
+        shrinks every process's effective credit."""
+        with self._lock:
+            return {
+                pid: (st.summary or {}).get("flow") or {}
+                for pid, st in self._peers.items()
+            }
+
     def dead_peer(self) -> tuple[int, int | None, str] | None:
         """(pid, last_tick, reason) of a failed peer, else None. EOF beats a
         heartbeat miss (it is definitive); each miss is recorded once."""
