@@ -151,6 +151,54 @@ def supervise(threads, processes, first_port, max_restarts, backoff, log_dir, pr
     sys.exit(0)
 
 
+@cli.command()
+@click.option("--host", type=str, default="127.0.0.1", help="monitoring server host")
+@click.option(
+    "--port",
+    type=int,
+    default=None,
+    help="monitoring server port (default PATHWAY_MONITORING_HTTP_PORT, 20000)",
+)
+@click.option("--ticks", type=int, default=None, help="capture window length in ticks")
+@click.option(
+    "--dir",
+    "out_dir",
+    type=str,
+    default=None,
+    help="capture output directory (default PATHWAY_PROFILE_DIR of the target run)",
+)
+@click.option("--status", is_flag=True, default=False, help="report the current window instead of arming one")
+def profile(host, port, ticks, out_dir, status):
+    """Arm a live ``jax.profiler`` capture window on a RUNNING pipeline via
+    its monitoring server's ``/profile`` endpoint (view the result in
+    TensorBoard/XProf)."""
+    import json as _json
+    import urllib.parse
+    import urllib.request
+
+    if port is None:
+        port = int(os.environ.get("PATHWAY_MONITORING_HTTP_PORT", "20000"))
+    qs = {}
+    if not status:
+        qs["ticks"] = str(ticks if ticks is not None else get_pathway_config().profile_ticks)
+        if out_dir:
+            qs["dir"] = out_dir
+    url = f"http://{host}:{port}/profile"
+    if qs:
+        url += "?" + urllib.parse.urlencode(qs)
+    try:
+        body = urllib.request.urlopen(url, timeout=5).read().decode()
+    except OSError as e:
+        raise click.ClickException(
+            f"cannot reach monitoring server at {host}:{port}: {e} "
+            "(is the pipeline running with with_http_server=True?)"
+        ) from e
+    doc = _json.loads(body)
+    click.echo(_json.dumps(doc, indent=2))
+    if doc.get("ok") is False:
+        raise click.ClickException(doc.get("error", "profile request failed"))
+
+
 @cli.command(context_settings={"ignore_unknown_options": True})
 @click.option("--record-path", type=str, default="./record", help="recorded persistence root")
 @click.option(

@@ -19,6 +19,7 @@ import numpy as np
 
 from pathway_tpu.engine.blocks import DeltaBatch, concat_batches
 from pathway_tpu.internals.trace import run_annotated as _run_annotated
+from pathway_tpu.observability import device as _device_prof
 
 END_OF_STREAM = np.iinfo(np.int64).max  # frontier value after all input closed
 
@@ -191,10 +192,15 @@ class Scheduler:
             node.stats_rows_in += rows_in
             if trace:
                 w0 = _time.time_ns()
+                # host/device split: traced dispatches inside this node
+                # accumulate their block_until_ready wait on sampled ticks
+                dev0 = _device_prof.thread_device_wait_ns()
             t0 = _time.perf_counter_ns()
             out = _run_annotated(node, node.process, inputs, time)
-            node.stats_time_ns += _time.perf_counter_ns() - t0
+            elapsed_ns = _time.perf_counter_ns() - t0
+            node.stats_time_ns += elapsed_ns
             if trace:
+                dev_ns = _device_prof.thread_device_wait_ns() - dev0
                 self.tracer.span(
                     f"sweep/{node.name}",
                     w0,
@@ -203,8 +209,13 @@ class Scheduler:
                         "pathway.operator.id": node.node_index,
                         "pathway.rows_in": rows_in,
                         "pathway.rows_out": sum(len(b) for b in out if b is not None),
+                        "pathway.device_ms": round(dev_ns / 1e6, 3),
                     },
                 )
+                if dev_ns:
+                    _device_prof.stats().note_span_split(
+                        f"sweep/{node.name}", max(0, elapsed_ns - dev_ns), dev_ns
+                    )
             self._route(node, out)
             any_work = True
         return any_work
@@ -213,6 +224,9 @@ class Scheduler:
         """Process everything pending at logical ``time`` to quiescence, then
         advance the frontier past it."""
         self.current_time = time
+        # device plane: steps an armed jax.profiler window, stamps the flight
+        # recorder's tick ring (two global reads when profiling is off)
+        _device_prof.tick_hook(time)
         tracer = self.tracer
         tick_token = tracer.begin_tick(time) if tracer is not None else None
         self._trace_active = tick_token is not None

@@ -108,7 +108,9 @@ def _jit_grouped(n_cols: int):
         )
         return order, ks, newg, counts, sums
 
-    return kernel
+    from pathway_tpu.observability import device as _dev_prof
+
+    return _dev_prof.traced_jit(f"engine.grouped/{n_cols}", kernel)
 
 
 _GROUPED_JIT: dict[int, Any] = {}
@@ -246,7 +248,9 @@ def _jit_probe():
         hi = jnp.searchsorted(sorted_keys, q, side="right")
         return lo, hi - lo
 
-    return kernel
+    from pathway_tpu.observability import device as _dev_prof
+
+    return _dev_prof.traced_jit("engine.join_probe", kernel)
 
 
 _PROBE_JIT: Any = None

@@ -76,6 +76,12 @@ class Runtime:
         _flow.install_from_env(self)
         try:
             return self._run(outputs, _obs.current())
+        except BaseException as e:
+            # flight recorder post-mortem (device plane): recent ticks +
+            # device events dumped to PATHWAY_FLIGHT_DIR before the error
+            # propagates (terminate_on_error aborts, dead-peer errors)
+            _obs.device.on_run_error(e, self)
+            raise
         finally:
             _obs.shutdown()
             # closing the gates wakes producers blocked on credit, so

@@ -283,6 +283,66 @@ class PathwayConfig:
     def run_id(self) -> str:
         return os.environ.get("PATHWAY_RUN_ID", "")
 
+    # ---- device profiling (observability plane, device side) ----------------
+    @property
+    def profile(self) -> str:
+        """Device profiling plane: ``on`` (default — compile/shape counters,
+        padding-waste accounting, device-memory gauges and the flight-recorder
+        ring, all at negligible cost), ``full`` (additionally measures the
+        host/device time split by blocking on every traced dispatch — use for
+        investigation, not steady state), or ``off``."""
+        raw = os.environ.get("PATHWAY_PROFILE", "on").strip().lower()
+        if raw in ("", "1", "true", "yes", "on"):
+            return "on"
+        if raw in ("0", "false", "no", "off"):
+            return "off"
+        if raw == "full":
+            return "full"
+        raise ValueError(f"PATHWAY_PROFILE must be off/on/full, got {raw!r}")
+
+    @property
+    def profile_dir(self) -> str | None:
+        """When set, capture a ``jax.profiler`` trace of the run's first
+        ``PATHWAY_PROFILE_TICKS`` ticks into this directory (viewable in
+        TensorBoard/XProf). Further windows can be triggered live via the
+        monitoring server's ``/profile?ticks=N`` endpoint or the
+        ``pathway_tpu profile`` CLI."""
+        return os.environ.get("PATHWAY_PROFILE_DIR") or None
+
+    @property
+    def profile_ticks(self) -> int:
+        """Length (ticks) of a ``jax.profiler`` capture window."""
+        return max(1, _env_int("PATHWAY_PROFILE_TICKS", 16))
+
+    @property
+    def profile_shape_warn(self) -> int:
+        """Per-callable compile-cache shape-set size past which the
+        recompile-storm detector flags the callable on ``/status`` — a
+        healthy bucketed pipeline keeps a small closed shape set."""
+        return max(2, _env_int("PATHWAY_PROFILE_SHAPE_WARN", 12))
+
+    @property
+    def profile_peak_tflops(self) -> float:
+        """Per-chip peak TFLOP/s used to turn the rough per-launch FLOP
+        estimates into a live MFU gauge (e.g. 197 for v5e bf16). 0 (default)
+        reports achieved FLOP/s without an MFU ratio."""
+        return max(0.0, _env_float("PATHWAY_PROFILE_PEAK_TFLOPS", 0.0))
+
+    @property
+    def flight_dir(self) -> str | None:
+        """Post-mortem flight-recorder dump directory: on
+        ``terminate_on_error`` aborts, ``OtherWorkerError`` and supervised
+        restarts, the bounded ring of recent ticks/device events is written
+        there as one JSON file per failure. Unset = no dumps (the ring still
+        records)."""
+        return os.environ.get("PATHWAY_FLIGHT_DIR") or None
+
+    @property
+    def flight_events(self) -> int:
+        """Flight-recorder ring capacity (device events; ticks keep a
+        quarter-sized ring of their own)."""
+        return max(64, _env_int("PATHWAY_FLIGHT_EVENTS", 1024))
+
     # ---- helpers ------------------------------------------------------------
     @property
     def total_workers(self) -> int:
@@ -321,6 +381,8 @@ class PathwayConfig:
                 "input_queue_rows",
                 "latency_slo_ms",
                 "monitoring_server",
+                "profile",
+                "flight_dir",
                 "run_id",
             )
         }

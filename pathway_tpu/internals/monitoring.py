@@ -121,6 +121,10 @@ def run_stats(runtime) -> dict[str, Any]:
     flow_status = _flow.status(runtime)
     if flow_status is not None:
         stats["flow"] = flow_status
+    # device profiling plane: per-callable compile/shape telemetry, pad-waste
+    # ratios, memory attribution, host/device time split, recompile-storm
+    # warnings (PATHWAY_PROFILE, on by default)
+    stats["device"] = _obs.device.status_summary(runtime)
     tracer = _obs.current()
     if tracer is not None:
         stats["trace"] = {
@@ -264,7 +268,31 @@ def prometheus_text(runtime) -> str:
             lines.append(
                 f'pathway_sink_latency_seconds_count{{{_fmt_label(sink=label)}}} {snap["count"]}'
             )
+    # ---- device profiling plane (compiles, pad waste, memory, FLOPs) --------
+    lines.extend(_obs.device.prometheus_lines(runtime))
     return "\n".join(lines) + "\n"
+
+
+def _profile_payload(query: str) -> bytes:
+    """``/profile?ticks=N[&dir=...]``: arm a live ``jax.profiler`` capture
+    window on the running pipeline (dir defaults to ``PATHWAY_PROFILE_DIR``).
+    With no query arguments, reports the current window state instead."""
+    from urllib.parse import parse_qs, unquote
+
+    from pathway_tpu.observability import device as _device
+
+    qs = parse_qs(query)
+    if not qs:
+        return json.dumps(
+            {"ok": True, "window": _device._profile_state()}
+        ).encode()
+    ticks = None
+    try:
+        ticks = int(qs["ticks"][0])
+    except (KeyError, ValueError, IndexError):
+        pass
+    path = unquote(qs["dir"][0]) if qs.get("dir") else None
+    return json.dumps(_device.request_profile(ticks, path)).encode()
 
 
 def _trace_payload(query: str) -> bytes:
@@ -330,6 +358,9 @@ class MonitoringHttpServer:
                     ctype = "application/json"
                 elif path.rstrip("/") == "/trace":
                     body = _trace_payload(query)
+                    ctype = "application/json"
+                elif path.rstrip("/") == "/profile":
+                    body = _profile_payload(query)
                     ctype = "application/json"
                 else:
                     self.send_response(404)

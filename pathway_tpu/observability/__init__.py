@@ -24,7 +24,7 @@ from __future__ import annotations
 
 import secrets
 
-from pathway_tpu.observability import aggregate, metrics, spans
+from pathway_tpu.observability import aggregate, device, metrics, spans
 from pathway_tpu.observability.metrics import (
     BUCKET_BOUNDS_S,
     Histogram,
@@ -68,6 +68,9 @@ def install_from_env(runtime=None) -> Tracer | None:
     from pathway_tpu.internals.config import get_pathway_config
 
     metrics.reset()
+    # device profiling plane (compile/pad/memory accounting, flight recorder,
+    # profiler windows) — on by default, independent of PATHWAY_TRACE
+    device.install_from_env(runtime)
     if _tracer is not None:
         try:
             _tracer.close(emit_root=False)
@@ -96,6 +99,7 @@ def shutdown() -> None:
     """Close the live tracer (flush + root span + file sink). Never raises —
     runs in ``finally`` blocks next to connector/server teardown."""
     global _tracer
+    device.shutdown()
     if _tracer is None:
         return
     try:
@@ -115,6 +119,7 @@ __all__ = [
     "backlog_gauges",
     "current",
     "derive_trace_id",
+    "device",
     "input_watermarks",
     "install_from_env",
     "metrics",

@@ -55,6 +55,13 @@ def local_summary(runtime) -> dict[str, Any]:
     plane = _flow.current()
     if plane is not None:
         summary["flow"] = plane.heartbeat_summary()
+    # device plane: compile/pad/memory rollup so the coordinator's /status
+    # attributes device cost across the whole pod
+    from pathway_tpu.observability import device as _device
+
+    dev = _device.heartbeat_summary()
+    if dev is not None:
+        summary["device"] = dev
     return summary
 
 
@@ -95,4 +102,11 @@ def cluster_status(runtime) -> dict[str, Any] | None:
             "bound": sum(f.get("bound") or 0 for f in flows.values()),
             "pressure_max": max(f.get("pressure") or 0.0 for f in flows.values()),
         }
+    from pathway_tpu.observability import device as _device
+
+    dev = _device.merge_heartbeat_summaries(
+        [p.get("device") for p in processes.values()]
+    )
+    if dev is not None:
+        out["device"] = dev
     return out

@@ -66,26 +66,33 @@ class Histogram:
 
     @staticmethod
     def merge(snapshots: list[dict]) -> dict[str, Any]:
+        """Positional bucket-count sum. Tolerates snapshots with missing,
+        short, or over-long ``counts`` (cluster peers may run a different
+        build generation with a different bucket table): short lists
+        contribute what they have, extra tail buckets extend the result —
+        merge stays associative and order-independent either way."""
         counts = [0] * (len(BUCKET_BOUNDS_S) + 1)
         total_sum = 0.0
         total_count = 0
         for s in snapshots:
-            for i, c in enumerate(s["counts"]):
+            for i, c in enumerate(s.get("counts") or ()):
+                if i >= len(counts):
+                    counts.extend([0] * (i + 1 - len(counts)))
                 counts[i] += c
-            total_sum += s["sum_s"]
-            total_count += s["count"]
+            total_sum += s.get("sum_s", 0.0)
+            total_count += s.get("count", 0)
         return {"counts": counts, "sum_s": total_sum, "count": total_count}
 
     @staticmethod
     def quantile(snapshot: dict, q: float) -> float | None:
         """Bucket-resolution quantile (upper bound of the bucket holding the
         q-th observation) for /status summaries."""
-        total = snapshot["count"]
-        if total == 0:
+        total = snapshot.get("count", 0)
+        if total <= 0:
             return None
         rank = q * total
         seen = 0
-        for i, c in enumerate(snapshot["counts"]):
+        for i, c in enumerate(snapshot.get("counts") or ()):
             seen += c
             if seen >= rank and c:
                 if i < len(BUCKET_BOUNDS_S):

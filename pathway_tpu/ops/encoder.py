@@ -28,6 +28,7 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from pathway_tpu.native import try_load as _try_load_native
+from pathway_tpu.observability import device as _dev_prof
 
 # C tokenizer kernel (None -> pure-Python fallback, bit-identical)
 _pwtok_native = _try_load_native("pwtok")
@@ -290,18 +291,24 @@ def encode(params: dict, cfg: EncoderConfig, token_ids: jax.Array, mask: jax.Arr
 
 
 @partial(jax.jit, static_argnames=("cfg",))
-def encode_jit(params: dict, cfg: EncoderConfig, token_ids: jax.Array, mask: jax.Array):
+def _encode_jit(params: dict, cfg: EncoderConfig, token_ids: jax.Array, mask: jax.Array):
     return encode(params, cfg, token_ids, mask)
 
 
 @partial(jax.jit, static_argnames=("cfg",))
-def encode_ids_jit(params: dict, cfg: EncoderConfig, token_ids: jax.Array):
+def _encode_ids_jit(params: dict, cfg: EncoderConfig, token_ids: jax.Array):
     """ids-only forward: the mask is recovered on device as ``ids != 0``
     (tokenizer contract: pad id is 0 and no real token maps to 0), and narrow
     int dtypes (int16 from the hash tokenizer) widen on device — so the
     host→device transfer is a single small integer array."""
     mask = token_ids != 0
     return encode(params, cfg, token_ids.astype(jnp.int32), mask)
+
+
+# device profiling plane: every encoder launch counts toward the per-callable
+# compile/shape telemetry on /status (+/metrics) — see observability/device.py
+encode_jit = _dev_prof.traced_jit("encoder.encode", _encode_jit)
+encode_ids_jit = _dev_prof.traced_jit("encoder.encode_ids", _encode_ids_jit)
 
 
 def contrastive_loss(params, cfg, tok_a, mask_a, tok_b, mask_b, temperature=0.05):
@@ -547,10 +554,40 @@ class JaxSentenceEncoder:
                 self.params,
                 param_shardings(self.cfg, mesh),
             )
+        self._param_count: int | None = None
+        # memory attribution: encoder weights show up as
+        # pathway_device_bytes{component="encoder_params"} while this
+        # instance lives (weakly registered — no lifetime coupling)
+        _dev_prof.register_memory(
+            self, "encoder_params", lambda enc: enc.param_bytes()
+        )
 
     @property
     def dimension(self) -> int:
         return self.cfg.d_model
+
+    def param_count(self) -> int:
+        if self._param_count is None:
+            self._param_count = int(
+                sum(int(np.prod(p.shape)) for p in jax.tree.leaves(self.params))
+            )
+        return self._param_count
+
+    def param_bytes(self) -> int:
+        return int(sum(p.nbytes for p in jax.tree.leaves(self.params)))
+
+    def _note_launch(self, ids, mask=None) -> None:
+        """Padding-waste + FLOP accounting for one encoder launch (rough
+        transformer-forward estimate: 2 · params · tokens — the BASELINE
+        bench formula — over the PADDED token grid the device actually
+        runs)."""
+        stats = _dev_prof.stats()
+        if not stats.enabled:
+            return
+        total = int(ids.shape[0]) * int(ids.shape[1])
+        real = int(np.count_nonzero(np.asarray(mask if mask is not None else ids)))
+        stats.note_pad_tokens("encoder", real, total - real)
+        stats.note_flops("encoder", 2.0 * self.param_count() * total)
 
     def encode_texts(self, texts: list[str]) -> np.ndarray:
         if not texts:
@@ -568,15 +605,19 @@ class JaxSentenceEncoder:
         device and the mask is re-derived there; otherwise the tokenizer's own
         mask is honored and shipped alongside."""
         ids, mask = self.tokenizer(texts)
+        self._note_launch(ids, mask)
         if getattr(self.tokenizer, "pad_id_zero", False):
             return encode_ids_jit(self.params, self.cfg, ids)
         return encode_jit(self.params, self.cfg, jnp.asarray(ids, jnp.int32), mask)
 
     def encode_tokens(self, ids: np.ndarray, mask: np.ndarray) -> np.ndarray:
+        self._note_launch(ids, mask)
         return np.asarray(encode_jit(self.params, self.cfg, ids, mask))
 
     def encode_ids_device(self, ids: np.ndarray | jax.Array) -> jax.Array:
         """Pre-tokenized ids (pad id 0) → embeddings, fully on device."""
+        if isinstance(ids, np.ndarray):
+            self._note_launch(ids)
         return encode_ids_jit(self.params, self.cfg, ids)
 
     @classmethod

@@ -33,6 +33,7 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from pathway_tpu.jax_compat import shard_map
+from pathway_tpu.observability import device as _dev_prof
 
 
 class KnnMetric(enum.Enum):
@@ -67,6 +68,7 @@ def _key_bits_of(keys: Sequence[Any]) -> np.ndarray:
     return np.fromiter((_key_bits_one(k) for k in keys), dtype=np.uint32, count=len(keys))
 
 
+@partial(_dev_prof.traced_jit, "knn.search")
 @partial(jax.jit, static_argnames=("k", "metric"))
 def _search_kernel(
     vectors: jax.Array,      # [N, d] f32
@@ -187,6 +189,7 @@ def _decode_hits(
     return out
 
 
+@partial(_dev_prof.traced_jit, "knn.scatter")
 @partial(jax.jit, donate_argnums=(0, 1, 2, 3))
 def _scatter_block(
     vectors: jax.Array,   # [N, d]
@@ -211,6 +214,7 @@ def _scatter_block(
     return vectors, norms_sq, valid, key_bits
 
 
+@partial(_dev_prof.traced_jit, "knn.pack_hits")
 @jax.jit
 def _pack_hits(scores: jax.Array, slot_ids: jax.Array) -> jax.Array:
     """Pack (scores [Q,k] f32, ids [Q,k] i32) into one [Q, 2k] f32 array so
@@ -227,6 +231,7 @@ def _unpack_hits(packed: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
     return packed[:, :k], packed[:, k:].astype(np.int64)
 
 
+@partial(_dev_prof.traced_jit, "knn.invalidate")
 @jax.jit
 def _invalidate(valid: jax.Array, slots: jax.Array) -> jax.Array:
     return valid.at[slots].set(False)
@@ -269,6 +274,19 @@ class BruteForceKnnIndex:
         # host key-bits u32) — slots+bits stay host-side so _apply_scatter can
         # pack them into ONE host→device transfer
         self._pending_device: list[tuple[np.ndarray, Any, np.ndarray]] = []
+        # memory attribution: index shards appear as
+        # pathway_device_bytes{component="knn_index"} while this instance lives
+        _dev_prof.register_memory(self, "knn_index", lambda ix: ix.device_bytes())
+
+    def device_bytes(self) -> int:
+        """Live device bytes of the index arrays (vectors + norms + validity +
+        tie-break bits)."""
+        return int(
+            self._vectors.nbytes
+            + self._norms_sq.nbytes
+            + self._valid.nbytes
+            + self._key_bits.nbytes
+        )
 
     def __getstate__(self):
         """Snapshot form: device arrays DMA'd to host (operator persistence
@@ -485,6 +503,14 @@ class BruteForceKnnIndex:
         host sync — chain into further device ops or pack for one fetch."""
         self._flush()
         q = self._prep_queries(queries)
+        stats = _dev_prof.stats()
+        if stats.enabled:
+            # rough probe cost: one dot per (query, slot) pair over the PADDED
+            # capacity — the padded-vs-valid gap is exactly the pad waste
+            stats.note_flops(
+                "knn.search", 2.0 * int(q.shape[0]) * self.capacity * self.dimension
+            )
+            stats.note_pad_rows("knn.search", len(self), self.capacity - len(self))
         return _search_kernel(
             self._vectors, self._norms_sq, self._valid, self._key_bits, q,
             k=min(k, self.capacity), metric=self.metric.value,

@@ -92,12 +92,15 @@ class MicrobatchDispatcher:
         accumulation mode: the engine keeps feeding rows and flushes the tail
         on its autocommit deadline."""
         from pathway_tpu import observability as _obs
+        from pathway_tpu.observability import device as _dev
 
         tracer = _obs.current() if self.label is not None else None
         if tracer is not None and tracer.tick_span_id is None:
             # head sampling: an unsampled tick records NO spans — dispatches
             # included (same gate as MicrobatchApplyNode's launch span)
             tracer = None
+        stats = _dev.stats()
+        profiled = stats.enabled
         out: list = []
         while self._items and (not only_full or len(self._items) >= self.max_batch):
             chunk = self._items[: self.max_batch]
@@ -106,26 +109,52 @@ class MicrobatchDispatcher:
             b = bucket_size(n, self.min_bucket, self.max_batch)
             pad = chunk[-1] if self.pad_item is None else self.pad_item
             padded = chunk + [pad] * (b - n)
-            if tracer is not None:
-                import time as _t
-
-                w0 = _t.time_ns()
-                results = self.fn(padded)
-                tracer.span(
-                    "device/dispatch",
-                    w0,
-                    _t.time_ns(),
-                    **{
-                        "pathway.udf": self.label,
-                        "pathway.bucket": b,
-                        "pathway.rows": n,
-                        # first sight of this padded shape = fresh jit
-                        # compile-cache entry on this process
-                        "pathway.cold_shape": tracer.first_shape(self.label, b),
-                    },
-                )
+            # cold = first sight of this padded launch shape on this process
+            # (the XLA compile-cache lifetime, so tracked process-wide, not
+            # per tracer); pad accounting runs on every launch. With the
+            # profile plane off the r8 per-tracer cold marker still stands.
+            label = self.label or getattr(self.fn, "__name__", "udf")
+            if profiled:
+                cold = stats.first_shape(f"udf:{label}", b)
+                stats.note_pad_rows(f"udf:{label}", n, b - n)
+                _dev.push_label(f"udf:{label}")
             else:
-                results = self.fn(padded)
+                cold = tracer is not None and tracer.first_shape(self.label, b)
+            try:
+                if tracer is not None or cold:
+                    import time as _t
+
+                    inner0 = _dev.thread_cold_s()
+                    w0 = _t.time_ns()
+                    results = self.fn(padded)
+                    w1 = _t.time_ns()
+                    if cold and profiled:
+                        # measured compile wall time: the cold call pays jit
+                        # trace + XLA compile (+ one execution) — accumulated
+                        # into the per-process compile-seconds counter, net
+                        # of compiles traced jits inside the launch already
+                        # booked for themselves
+                        stats.note_cold(
+                            f"udf:{label}",
+                            (w1 - w0) / 1e9,
+                            b,
+                            inner_s=_dev.thread_cold_s() - inner0,
+                        )
+                    if tracer is not None:
+                        attrs = {
+                            "pathway.udf": self.label,
+                            "pathway.bucket": b,
+                            "pathway.rows": n,
+                            "pathway.cold_shape": cold,
+                        }
+                        if cold:
+                            attrs["pathway.compile_ms"] = round((w1 - w0) / 1e6, 3)
+                        tracer.span("device/dispatch", w0, w1, attrs)
+                else:
+                    results = self.fn(padded)
+            finally:
+                if profiled:
+                    _dev.pop_label()
             if len(results) != b:
                 raise ValueError(
                     f"microbatch fn returned {len(results)} results for batch of {b}"

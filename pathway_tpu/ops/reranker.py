@@ -23,6 +23,7 @@ from pathway_tpu.ops.encoder import (
     encode,
     init_params,
 )
+from pathway_tpu.observability import device as _dev_prof
 from pathway_tpu.ops.microbatch import LENGTH_MAX_BUCKET, bucket_size
 
 _SEP = 2  # reserved token id used between query and doc
@@ -45,8 +46,12 @@ def score(params: dict, cfg: EncoderConfig, token_ids: jax.Array, mask: jax.Arra
 
 
 @partial(jax.jit, static_argnames=("cfg",))
-def score_jit(params: dict, cfg: EncoderConfig, token_ids: jax.Array, mask: jax.Array):
+def _score_jit(params: dict, cfg: EncoderConfig, token_ids: jax.Array, mask: jax.Array):
     return score(params, cfg, token_ids, mask)
+
+
+# device profiling plane: compile/shape telemetry per reranker launch
+score_jit = _dev_prof.traced_jit("reranker.score", _score_jit)
 
 
 class JaxCrossEncoder:
@@ -56,6 +61,12 @@ class JaxCrossEncoder:
         self.cfg = cfg or EncoderConfig(n_layers=4)
         self.params = init_reranker_params(self.cfg, jax.random.PRNGKey(seed))
         self.tokenizer = HashTokenizer(self.cfg.vocab_size, self.cfg.max_len)
+        self._param_count: int | None = None
+        _dev_prof.register_memory(
+            self,
+            "reranker_params",
+            lambda ce: int(sum(p.nbytes for p in jax.tree.leaves(ce.params))),
+        )
 
     def score_pairs(self, pairs: list[tuple[str, str]]) -> np.ndarray:
         if not pairs:
@@ -80,4 +91,13 @@ class JaxCrossEncoder:
             t = t[:L]
             ids[i, : len(t)] = t
             mask[i, : len(t)] = True
+        stats = _dev_prof.stats()
+        if stats.enabled:
+            if self._param_count is None:
+                self._param_count = int(
+                    sum(int(np.prod(p.shape)) for p in jax.tree.leaves(self.params))
+                )
+            real = int(mask.sum())
+            stats.note_pad_tokens("reranker", real, ids.size - real)
+            stats.note_flops("reranker", 2.0 * self._param_count * ids.size)
         return np.asarray(score_jit(self.params, self.cfg, ids, mask))

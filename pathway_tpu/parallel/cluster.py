@@ -572,19 +572,29 @@ class ClusterRuntime:
             rows_in = sum(len(b) for b in inputs if b is not None)
             node.stats_rows_in += rows_in
             if trace:
+                from pathway_tpu.observability import device as _dev_prof
+
                 w0 = _time.time_ns()
+                dev0 = _dev_prof.thread_device_wait_ns()
             out = run_annotated(node, node.process, inputs, time)
             if trace:
+                w1 = _time.time_ns()
+                dev_ns = _dev_prof.thread_device_wait_ns() - dev0
                 self.tracer.span(
                     f"sweep/{node.name}",
                     w0,
-                    _time.time_ns(),
+                    w1,
                     {
                         "pathway.operator.id": node.node_index,
                         "pathway.worker": lw.index,
                         "pathway.rows_in": rows_in,
+                        "pathway.device_ms": round(dev_ns / 1e6, 3),
                     },
                 )
+                if dev_ns:
+                    _dev_prof.stats().note_span_split(
+                        f"sweep/{node.name}", max(0, w1 - w0 - dev_ns), dev_ns
+                    )
             self._route(lw, node, out)
             any_work = True
         return any_work
@@ -707,6 +717,9 @@ class ClusterRuntime:
 
     def run_tick(self, time: int, skip_poll: bool = False) -> None:
         self.current_time = time
+        from pathway_tpu.observability import device as _dev_prof
+
+        _dev_prof.tick_hook(time)
         tracer = self.tracer
         tick_token = tracer.begin_tick(time) if tracer is not None else None
         self._trace_active = tick_token is not None
@@ -775,6 +788,13 @@ class ClusterRuntime:
             self.hb_client.summary_fn = lambda: _obs.aggregate.local_summary(self)
         try:
             return self._run_inner(outputs)
+        except BaseException as e:
+            # flight-recorder post-mortem: on an OtherWorkerError the dump
+            # names the dead peer and its last known tick (the survivors are
+            # where the post-mortem evidence lives — the dead process wrote
+            # nothing)
+            _obs.device.on_run_error(e, self)
+            raise
         finally:
             self.tracer = None
             _obs.shutdown()

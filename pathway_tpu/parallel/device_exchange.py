@@ -55,15 +55,19 @@ def _jitted_exchange(mesh, axis: str, n_cols: int, with_dest: bool = False):
     if with_dest:
         in_specs.append(P(axis))
     from pathway_tpu.jax_compat import shard_map
+    from pathway_tpu.observability import device as _dev_prof
 
-    return jax.jit(
-        shard_map(
-            kern,
-            mesh=mesh,
-            in_specs=tuple(in_specs),
-            out_specs=(P(None, axis), P(axis), P(axis), [P(axis)] * n_cols),
-            check=True,
-        )
+    return _dev_prof.traced_jit(
+        "device_exchange.all_to_all",
+        jax.jit(
+            shard_map(
+                kern,
+                mesh=mesh,
+                in_specs=tuple(in_specs),
+                out_specs=(P(None, axis), P(axis), P(axis), [P(axis)] * n_cols),
+                check=True,
+            )
+        ),
     )
 
 

@@ -183,19 +183,29 @@ class ShardedRuntime:
             rows_in = sum(len(b) for b in inputs if b is not None)
             node.stats_rows_in += rows_in
             if trace:
+                from pathway_tpu.observability import device as _dev_prof
+
                 w0 = _t.time_ns()
+                dev0 = _dev_prof.thread_device_wait_ns()
             out = run_annotated(node, node.process, inputs, time)
             if trace:
+                w1 = _t.time_ns()
+                dev_ns = _dev_prof.thread_device_wait_ns() - dev0
                 self.tracer.span(
                     f"sweep/{node.name}",
                     w0,
-                    _t.time_ns(),
+                    w1,
                     {
                         "pathway.operator.id": node.node_index,
                         "pathway.worker": worker.index,
                         "pathway.rows_in": rows_in,
+                        "pathway.device_ms": round(dev_ns / 1e6, 3),
                     },
                 )
+                if dev_ns:
+                    _dev_prof.stats().note_span_split(
+                        f"sweep/{node.name}", max(0, w1 - w0 - dev_ns), dev_ns
+                    )
             if self._route(worker, node, out):
                 any_work = True
             any_work = any_work or any(b is not None for b in inputs)
@@ -247,6 +257,9 @@ class ShardedRuntime:
 
     def run_tick(self, time: int) -> None:
         self.current_time = time
+        from pathway_tpu.observability import device as _dev_prof
+
+        _dev_prof.tick_hook(time)
         tracer = self.tracer
         tick_token = tracer.begin_tick(time) if tracer is not None else None
         self._trace_active = tick_token is not None
@@ -296,6 +309,9 @@ class ShardedRuntime:
         try:
             self.tracer = _obs.current()
             return self._run_inner(outputs)
+        except BaseException as e:
+            _obs.device.on_run_error(e, self)  # flight-recorder post-mortem
+            raise
         finally:
             self.tracer = None
             _obs.shutdown()

@@ -187,6 +187,26 @@ class Supervisor:
                 exit_code=int(codes[failed[0]] or 0),
                 restarts_so_far=self.restarts,
             )
+            # flight-recorder post-mortem (device plane): the supervisor is
+            # the surviving authority on WHICH process failed and when —
+            # dumped before the relaunch overwrites the evidence
+            from pathway_tpu.observability import device as _dev_prof
+
+            _dev_prof.flight_note(
+                "supervisor_restart",
+                attempt=attempt,
+                failed=failed,
+                exit_codes=[c for c in codes],
+            )
+            _dev_prof.flight_dump(
+                "supervisor_restart",
+                extra={
+                    "attempt": attempt,
+                    "failed_processes": failed,
+                    "exit_codes": codes,
+                    "restarts_so_far": self.restarts,
+                },
+            )
             if attempt >= self.max_restarts:
                 self._export_trace()
                 raise SupervisorGaveUp(

@@ -351,6 +351,72 @@ def test_histogram_merge_and_quantile():
     assert Histogram.quantile({"counts": [0] * (len(BUCKET_BOUNDS_S) + 1), "sum_s": 0, "count": 0}, 0.5) is None
 
 
+def test_histogram_merge_edge_cases_empty_and_disjoint():
+    """ISSUE 5 satellite: merge/quantile over empty and disjoint-bucket
+    snapshots (a cluster peer on another build generation may ship a counts
+    list of a different length, or nothing at all)."""
+    empty = Histogram().snapshot()
+    assert Histogram.merge([]) == {
+        "counts": [0] * (len(BUCKET_BOUNDS_S) + 1),
+        "sum_s": 0.0,
+        "count": 0,
+    }
+    assert Histogram.merge([empty, empty])["count"] == 0
+    assert Histogram.quantile(Histogram.merge([]), 0.5) is None
+    # disjoint buckets: one peer only hit the lowest bucket, the other only
+    # the overflow tail — the merge keeps both ends
+    low = Histogram()
+    low.observe(1e-6)
+    high = Histogram()
+    high.observe(1e9)
+    merged = Histogram.merge([low.snapshot(), high.snapshot()])
+    assert merged["count"] == 2
+    assert merged["counts"][0] == 1 and merged["counts"][-1] == 1
+    assert Histogram.quantile(merged, 0.25) == BUCKET_BOUNDS_S[0]
+    assert Histogram.quantile(merged, 0.99) == float("inf")
+    # short / missing counts lists degrade instead of crashing
+    ragged = Histogram.merge([{"counts": [3], "sum_s": 0.1, "count": 3}, empty])
+    assert ragged["counts"][0] == 3 and ragged["count"] == 3
+    assert Histogram.merge([{"sum_s": 0.0, "count": 0}])["count"] == 0
+    # over-long counts extend the result rather than dropping the tail
+    long = Histogram.merge(
+        [{"counts": [0] * (len(BUCKET_BOUNDS_S) + 2) + [7], "sum_s": 1.0, "count": 7}]
+    )
+    assert long["counts"][-1] == 7
+
+
+def test_histogram_merge_associative_and_order_independent():
+    """ISSUE 5 satellite property test: merge is associative and
+    order-independent over randomized snapshots."""
+    import itertools
+    import random
+
+    rng = random.Random(1234)
+    snaps = []
+    for _ in range(4):
+        h = Histogram()
+        for _ in range(rng.randrange(0, 40)):
+            h.observe(rng.uniform(0, 64) ** 2 / 64.0)
+        snaps.append(h.snapshot())
+    baseline = Histogram.merge(snaps)
+    for perm in itertools.permutations(snaps):
+        m = Histogram.merge(list(perm))
+        assert m["counts"] == baseline["counts"]
+        assert m["count"] == baseline["count"]
+        assert m["sum_s"] == pytest.approx(baseline["sum_s"])
+    # associativity: merge(merge(a,b), merge(c,d)) == merge(a,b,c,d), and any
+    # other parenthesization
+    left = Histogram.merge(
+        [Histogram.merge(snaps[:2]), Histogram.merge(snaps[2:])]
+    )
+    right = Histogram.merge(
+        [snaps[0], Histogram.merge([snaps[1], Histogram.merge(snaps[2:])])]
+    )
+    assert left["counts"] == baseline["counts"] == right["counts"]
+    assert left["count"] == baseline["count"] == right["count"]
+    assert left["sum_s"] == pytest.approx(baseline["sum_s"])
+
+
 def test_backlog_gauge_sees_queued_rows():
     from pathway_tpu.engine.operators import StreamInputNode
 
