@@ -16,6 +16,7 @@ import numpy as np
 
 from pathway_tpu.internals import dtype as dt
 from pathway_tpu.internals.keys import hash_column, row_keys, splitmix64
+from pathway_tpu.observability import engine_phases as _phases
 
 
 class DeltaBatch:
@@ -106,6 +107,7 @@ class DeltaBatch:
         diffs: Iterable[int] | None = None,
         np_dtypes: Mapping[str, np.dtype] | None = None,
     ) -> "DeltaBatch":
+        tok = _phases.start()
         keys_arr = (
             keys.astype(np.uint64, copy=False)
             if isinstance(keys, np.ndarray)
@@ -122,6 +124,7 @@ class DeltaBatch:
             if diffs is None
             else np.fromiter(diffs, dtype=np.int64, count=n)
         )
+        _phases.stop(tok, "realloc")
         return DeltaBatch(keys_arr, diffs_arr, data, time)
 
 
@@ -207,23 +210,43 @@ def concat_batches(batches: list[DeltaBatch]) -> DeltaBatch | None:
         return None
     if len(batches) == 1:
         return batches[0]
+    tok = _phases.start()
     time = batches[-1].time
     keys = np.concatenate([b.keys for b in batches])
     diffs = np.concatenate([b.diffs for b in batches])
     names = batches[0].data.keys()
     data = {n: concat_cols([b.data[n] for b in batches]) for n in names}
+    _phases.stop(tok, "realloc")
     return DeltaBatch(keys, diffs, data, time)
 
 
 def consolidate(batch: DeltaBatch) -> DeltaBatch:
     """Sum diffs per (key, row-digest); drop rows with net diff 0.
 
-    The block analogue of differential's arrangement consolidation.
+    The block analogue of differential's arrangement consolidation. Canonical
+    output order: sorted by key, then net diff ascending (retractions precede
+    insertions), then row digest — deterministic for any input permutation.
     """
     if len(batch) <= 1:
         if len(batch) == 1 and batch.diffs[0] == 0:
             return batch.take(np.empty(0, dtype=np.int64))
         return batch
+    tok = _phases.start()
+    out = _consolidate_impl(batch)
+    _phases.stop(tok, "consolidate")
+    return out
+
+
+def _consolidate_impl(batch: DeltaBatch) -> DeltaBatch:
+    # fast path — the shape every freshly-polled input block has: all inserts,
+    # no duplicate keys. Nothing can net or merge, so the canonical form is
+    # just a key sort; the per-column row-digest hash (the dominant cost of
+    # the general path) is skipped entirely.
+    if bool((batch.diffs > 0).all()):
+        order = np.argsort(batch.keys, kind="stable")
+        k = batch.keys[order]
+        if not bool((k[1:] == k[:-1]).any()):
+            return batch.take(order)
     digests = batch.row_digest()
     order = np.lexsort((digests, batch.keys))
     k = batch.keys[order]
@@ -243,6 +266,148 @@ def consolidate(batch: DeltaBatch) -> DeltaBatch:
     out = batch.take(kept_idx[final])
     out.diffs = kept_sums[final]
     return out
+
+
+def net_input_batch(batch: DeltaBatch) -> DeltaBatch:
+    """Net a freshly-polled input block — ``consolidate`` semantics, minus the
+    canonical key sort when the block provably cannot net: all inserts, no
+    duplicate keys, the overwhelmingly common poll shape. Such a block is
+    returned AS IS, in arrival order, removing an O(n log n) +
+    full-block-copy tax from every streaming tick (BASELINE §incremental).
+
+    Arrival order is deterministic (it is the connector log's order, polled
+    on the owning worker), and consolidating sinks (subscribe, output
+    writers, final captured state) re-canonicalize at emission, so results
+    are unchanged. The one observable difference: the RAW per-tick update
+    stream of a passthrough pipeline (``CaptureNode.deltas`` /
+    ``compute_and_print_update_stream``) now lists a net-free input block's
+    rows in arrival order rather than key-sorted — same multiset, same
+    determinism, different within-tick order."""
+    if len(batch) <= 1:
+        if len(batch) == 1 and batch.diffs[0] == 0:
+            return batch.take(np.empty(0, dtype=np.int64))
+        return batch
+    if bool((batch.diffs > 0).all()):
+        k = np.sort(batch.keys)
+        if not bool((k[1:] == k[:-1]).any()):
+            return batch
+    return consolidate(batch)
+
+
+def _member(keys: np.ndarray, sorted_set: np.ndarray) -> np.ndarray:
+    """bool[n]: is each key in the sorted unique ``sorted_set``."""
+    if not len(sorted_set) or not len(keys):
+        return np.zeros(len(keys), dtype=bool)
+    pos = np.searchsorted(sorted_set, keys).clip(0, len(sorted_set) - 1)
+    return sorted_set[pos] == keys
+
+
+def interleave_positions(
+    a_keys: np.ndarray, b_keys: np.ndarray
+) -> tuple[np.ndarray, np.ndarray]:
+    """Merged positions for two SORTED key runs: ``(ia, ib)`` such that
+    scattering ``a`` to ``ia`` and ``b`` to ``ib`` yields one sorted run.
+    On ties, ``a``'s rows precede ``b``'s (side=left/right below) — the
+    order a stable argsort over their concatenation would give. The shared
+    primitive behind the groupby state merge, segment compaction and
+    ``merge_consolidated``."""
+    ia = np.arange(len(a_keys), dtype=np.int64) + np.searchsorted(
+        b_keys, a_keys, side="left"
+    )
+    ib = np.arange(len(b_keys), dtype=np.int64) + np.searchsorted(
+        a_keys, b_keys, side="right"
+    )
+    return ia, ib
+
+
+def scatter_cols(parts: list[np.ndarray], positions: list[np.ndarray], total: int) -> np.ndarray:
+    """Scatter column parts to their merged positions (concat_cols dtype
+    discipline: same dtypes keep them, mixes degrade to object with
+    datetime64 scalars kept intact)."""
+    live = [p for p in parts if len(p)]
+    if live and all(p.dtype == live[0].dtype for p in live):
+        out = np.empty(total, dtype=live[0].dtype)
+    else:
+        out = np.empty(total, dtype=object)
+    for p, pos in zip(parts, positions):
+        if not len(p):
+            continue
+        if out.dtype == object and p.dtype.kind in ("M", "m"):
+            out[pos] = list(p)
+        else:
+            out[pos] = p
+    return out
+
+
+def merge_consolidated(base: DeltaBatch | None, delta: DeltaBatch | None) -> DeltaBatch | None:
+    """O(delta)-flavored consolidation: merge two **individually consolidated**
+    batches into one consolidated batch, byte-identical to
+    ``consolidate(concat_batches([base, delta]))``.
+
+    Keys present on only one side pass through untouched — no re-sort, no
+    re-hash of the disjoint bulk. Only the rows of keys present on BOTH sides
+    (the actually-contended state) are re-consolidated at digest granularity;
+    the three sorted runs are then interleaved by searchsorted positions.
+    This is the block engine's analogue of differential's merge batching: an
+    already-consolidated arrangement absorbs a consolidated delta at cost
+    proportional to the overlap, not the world.
+    """
+    if base is None or base.is_empty:
+        return delta
+    if delta is None or delta.is_empty:
+        return base
+    tok = _phases.start()
+    try:
+        a_keys, b_keys = base.keys, delta.keys
+        a_uk = a_keys[group_starts(a_keys)]
+        b_uk = b_keys[group_starts(b_keys)]
+        pos = np.searchsorted(b_uk, a_uk).clip(0, len(b_uk) - 1)
+        shared = a_uk[b_uk[pos] == a_uk]
+        a_sh = _member(a_keys, shared)
+        b_sh = _member(b_keys, shared)
+        parts: list[DeltaBatch] = []
+        a_rest = base.take(np.flatnonzero(~a_sh)) if a_sh.any() else base
+        b_rest = delta.take(np.flatnonzero(~b_sh)) if b_sh.any() else delta
+        parts.append(a_rest)
+        parts.append(b_rest)
+        if len(shared):
+            sub = concat_batches(
+                [base.take(np.flatnonzero(a_sh)), delta.take(np.flatnonzero(b_sh))]
+            )
+            net = _consolidate_impl(sub) if sub is not None and len(sub) > 1 else sub
+            if net is not None and len(net):
+                parts.append(net)
+        parts = [p for p in parts if p is not None and len(p)]
+        if not parts:
+            return DeltaBatch.empty(base.data.keys(), delta.time)
+        if len(parts) == 1:
+            only = parts[0]
+            return DeltaBatch(only.keys, only.diffs, only.data, delta.time)
+        # interleave: keys are disjoint ACROSS parts, so each row's merged
+        # position is its own index plus the count of smaller keys elsewhere
+        # (the k-part generalization of interleave_positions)
+        key_parts = [p.keys for p in parts]
+        total = sum(len(k) for k in key_parts)
+        positions: list[np.ndarray] = []
+        for i, ki in enumerate(key_parts):
+            pos_i = np.arange(len(ki), dtype=np.int64)
+            for j, kj in enumerate(key_parts):
+                if i != j:
+                    pos_i += np.searchsorted(kj, ki)
+            positions.append(pos_i)
+        out_keys = np.empty(total, dtype=np.uint64)
+        out_diffs = np.empty(total, dtype=np.int64)
+        for p, pos_i in zip(parts, positions):
+            out_keys[pos_i] = p.keys
+            out_diffs[pos_i] = p.diffs
+        names = list(base.data.keys())
+        data = {
+            n: scatter_cols([p.data[n] for p in parts], positions, total)
+            for n in names
+        }
+        return DeltaBatch(out_keys, out_diffs, data, delta.time)
+    finally:
+        _phases.stop(tok, "consolidate")
 
 
 def apply_diffs_to_state(state: dict, batch: DeltaBatch) -> None:

@@ -18,7 +18,13 @@ from __future__ import annotations
 import numpy as np
 
 from pathway_tpu.engine import jax_kernels
-from pathway_tpu.engine.blocks import concat_cols, group_starts
+from pathway_tpu.engine.blocks import (
+    concat_cols,
+    group_starts,
+    interleave_positions,
+    scatter_cols,
+)
+from pathway_tpu.observability import engine_phases as _phases
 
 
 class _Segment:
@@ -43,6 +49,7 @@ class _Segment:
         return len(self.jk) - self.n_dead
 
     def sort(self) -> None:
+        tok = _phases.start()
         order = np.argsort(self.jk, kind="stable")
         self.jk = self.jk[order]
         self.rk = self.rk[order]
@@ -50,6 +57,27 @@ class _Segment:
         if self.dead is not None:
             self.dead = self.dead[order]
         self.sorted = True
+        _phases.stop(tok, "rehash")
+
+
+def _merge_sorted_segments(a: "_Segment", b: "_Segment", n_cols: int) -> "_Segment":
+    """Interleave two sorted segments by searchsorted positions (no argsort).
+    Equal join keys keep part order: ``a``'s rows precede ``b``'s — the same
+    tie discipline a stable argsort over their concatenation would give."""
+    na, nb = len(a), len(b)
+    ia, ib = interleave_positions(a.jk, b.jk)
+    total = na + nb
+    jk = np.empty(total, dtype=np.uint64)
+    jk[ia] = a.jk
+    jk[ib] = b.jk
+    rk = np.empty(total, dtype=np.uint64)
+    rk[ia] = a.rk
+    rk[ib] = b.rk
+    positions = [ia, ib]
+    cols = [
+        scatter_cols([a.cols[i], b.cols[i]], positions, total) for i in range(n_cols)
+    ]
+    return _Segment(jk, rk, cols, is_sorted=True)
 
 
 def _expand_ranges(lo: np.ndarray, cnt: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
@@ -73,6 +101,9 @@ class ColumnarMultimap:
     """
 
     MAX_SEGMENTS = 12
+    # insert-time backstop: an arrangement that is never probed or deleted
+    # (a one-sided-quiet join store) must still not fragment without bound
+    MAX_SEGMENTS_HARD = 64
     # segments at most this size are sorted eagerly on first probe
     SMALL_SEGMENT = 4096
 
@@ -92,13 +123,27 @@ class ColumnarMultimap:
         seg = _Segment(jk, rk, list(cols), is_sorted=False)
         self.segments.append(seg)
         self.n_live += len(seg)
-        if len(self.segments) > self.MAX_SEGMENTS:
+        # segment-count compaction normally triggers on the next probe/delete
+        # (see match/delete) — an arrangement that only ever absorbs inserts
+        # (an insert-mostly join side whose opposite side went quiet) pays
+        # nothing until something actually reads it. The HARD bound is the
+        # memory-fragmentation backstop for exactly that never-read shape.
+        if len(self.segments) > self.MAX_SEGMENTS_HARD:
             self._compact()
 
     def delete(self, jk: np.ndarray, rk: np.ndarray) -> None:
         """Tombstone the rows with the given (jk, rk) pairs (rk decides)."""
         if not len(jk):
             return
+        tok = _phases.start()
+        try:
+            self._delete_impl(jk, rk)
+        finally:
+            _phases.stop(tok, "rehash")
+
+    def _delete_impl(self, jk: np.ndarray, rk: np.ndarray) -> None:
+        if len(self.segments) > self.MAX_SEGMENTS:
+            self._compact_impl()
         removed = 0
         d_order: np.ndarray | None = None  # lazy sort of the delete keys
         for seg in self.segments:
@@ -121,7 +166,12 @@ class ColumnarMultimap:
             hit = seg.rk[ofs] == rk[q_idx]
             if seg.dead is not None:
                 hit &= ~seg.dead[ofs]
-            kill = ofs[hit]
+            # unique: duplicate delete requests in ONE call match the same
+            # still-alive offset twice — counting it twice corrupts
+            # n_dead/n_live (rows turn invisible, compaction drops live
+            # segments). Dedup keeps the kill-all-matching-copies semantics
+            # while counting each physical row once.
+            kill = np.unique(ofs[hit])
             if len(kill):
                 if seg.dead is None:
                     seg.dead = np.zeros(len(seg), dtype=bool)
@@ -134,6 +184,13 @@ class ColumnarMultimap:
             self._compact()
 
     def _compact(self) -> None:
+        tok = _phases.start()
+        try:
+            self._compact_impl()
+        finally:
+            _phases.stop(tok, "rehash")
+
+    def _compact_impl(self) -> None:
         live_parts: list[_Segment] = []
         for seg in self.segments:
             if seg.n_dead == 0:
@@ -142,22 +199,83 @@ class ColumnarMultimap:
                 keep = ~seg.dead
                 live_parts.append(
                     _Segment(
-                        seg.jk[keep], seg.rk[keep], [c[keep] for c in seg.cols], False
+                        seg.jk[keep],
+                        seg.rk[keep],
+                        [c[keep] for c in seg.cols],
+                        bool(seg.sorted),
                     )
                 )
         if not live_parts:
             self.segments = []
             return
-        jk = np.concatenate([s.jk for s in live_parts])
-        rk = np.concatenate([s.rk for s in live_parts])
-        cols = [
-            concat_cols([s.cols[i] for s in live_parts]) for i in range(self.n_cols)
-        ]
-        merged = _Segment(jk, rk, cols, is_sorted=False)
-        merged.sort()
+        # O(delta) re-arrangement: the already-sorted base segment(s) are
+        # MERGED, not re-sorted — only the fresh (unsorted) churn pays an
+        # argsort, at its own size. Runs of consecutive unsorted parts are
+        # concat+argsorted together (stable: equal keys keep part order),
+        # then the sorted runs fold-merge by searchsorted positions, which
+        # also keeps equal keys in part order — byte-identical to the old
+        # whole-arrangement stable argsort.
+        runs: list[_Segment] = []
+        pending: list[_Segment] = []
+
+        def _flush_pending() -> None:
+            if not pending:
+                return
+            if len(pending) == 1:
+                part = pending[0]
+            else:
+                jk = np.concatenate([s.jk for s in pending])
+                rk = np.concatenate([s.rk for s in pending])
+                cols = [
+                    concat_cols([s.cols[i] for s in pending])
+                    for i in range(self.n_cols)
+                ]
+                part = _Segment(jk, rk, cols, is_sorted=False)
+            if not part.sorted:
+                part.sort()
+            runs.append(part)
+            pending.clear()
+
+        for part in live_parts:
+            if part.sorted:
+                _flush_pending()
+                runs.append(part)
+            else:
+                pending.append(part)
+        _flush_pending()
+        merged = runs[0]
+        for nxt in runs[1:]:
+            merged = _merge_sorted_segments(merged, nxt, self.n_cols)
+        # no-tombstone invariant for the compacted base: live_parts strips
+        # dead rows before merging, and merges never introduce tombstones
+        assert merged.dead is None
         self.segments = [merged]
 
     # ------------------------------------------------------------------ probes
+
+    @staticmethod
+    def _probe_sorted(seg: _Segment, q_jk: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+        """(lo, count) match ranges of each probe key in a sorted segment —
+        the jitted device kernel for big probes, numpy searchsorted otherwise."""
+        if jax_kernels.probe_eligible(len(seg), len(q_jk)):
+            tok = _phases.start()
+            try:
+                return jax_kernels.join_probe(seg.jk, q_jk)
+            except Exception:  # jax runtime failure → numpy, stop routing
+                import logging
+
+                logging.getLogger(__name__).warning(
+                    "JAX join-probe kernel failed; falling back to "
+                    "numpy and disabling kernel routing for this "
+                    "process",
+                    exc_info=True,
+                )
+                jax_kernels.disable()
+            finally:
+                _phases.stop(tok, "kernel")
+        lo = np.searchsorted(seg.jk, q_jk, side="left")
+        cnt = np.searchsorted(seg.jk, q_jk, side="right") - lo
+        return lo, cnt
 
     def _empty_match(self) -> tuple[np.ndarray, np.ndarray, list[np.ndarray]]:
         return (
@@ -176,6 +294,32 @@ class ColumnarMultimap:
         """
         if not len(q_jk) or not self.segments:
             return self._empty_match()
+        tok = _phases.start()
+        try:
+            return self._match_impl(q_jk)
+        finally:
+            _phases.stop(tok, "probe")
+
+    def _match_impl(
+        self, q_jk: np.ndarray
+    ) -> tuple[np.ndarray, np.ndarray, list[np.ndarray]]:
+        if len(self.segments) > self.MAX_SEGMENTS:
+            self._compact()
+        # fast path — the steady state after compaction: one sorted,
+        # tombstone-free segment. Probe and gather directly, no per-segment
+        # parts lists and no concat (BASELINE §incremental micro-bench).
+        if len(self.segments) == 1:
+            seg = self.segments[0]
+            if seg.sorted and seg.dead is None and seg.n_live:
+                lo, cnt = self._probe_sorted(seg, q_jk)
+                q_idx, ofs = _expand_ranges(lo, cnt)
+                if not len(ofs):
+                    return self._empty_match()
+                return (
+                    q_idx,
+                    seg.rk[ofs],
+                    [seg.cols[i][ofs] for i in range(self.n_cols)],
+                )
         q_parts: list[np.ndarray] = []
         rk_parts: list[np.ndarray] = []
         col_parts: list[list[np.ndarray]] = [[] for _ in range(self.n_cols)]
@@ -190,24 +334,7 @@ class ColumnarMultimap:
                 if seg.probes >= 2 or len(seg) <= max(self.SMALL_SEGMENT, len(q_jk)):
                     seg.sort()
             if seg.sorted:
-                lo = cnt = None
-                if jax_kernels.probe_eligible(len(seg), len(q_jk)):
-                    try:
-                        lo, cnt = jax_kernels.join_probe(seg.jk, q_jk)
-                    except Exception:  # jax runtime failure → numpy, stop routing
-                        import logging
-
-                        logging.getLogger(__name__).warning(
-                            "JAX join-probe kernel failed; falling back to "
-                            "numpy and disabling kernel routing for this "
-                            "process",
-                            exc_info=True,
-                        )
-                        jax_kernels.disable()
-                        lo = cnt = None
-                if lo is None:
-                    lo = np.searchsorted(seg.jk, q_jk, side="left")
-                    cnt = np.searchsorted(seg.jk, q_jk, side="right") - lo
+                lo, cnt = self._probe_sorted(seg, q_jk)
                 q_idx, ofs = _expand_ranges(lo, cnt)
             else:
                 if q_order is None:

@@ -87,11 +87,29 @@ def _device(force_cpu: bool = False):
 # ------------------------------------------------------------------ groupby
 
 
-def _jit_grouped(n_cols: int):
+def _donate_active(dev) -> bool:
+    """Buffer donation on tick-loop jit entry points (PATHWAY_ARRANGE_DONATE):
+    per-tick inputs (probe queries, grouped keys/diffs/columns) are dead after
+    the call, so XLA may reuse their device memory for outputs — a realloc+copy
+    saved every tick. ``auto`` donates on tpu/gpu only: the CPU backend
+    ignores donation and warns."""
+    from pathway_tpu.internals.config import get_pathway_config
+
+    mode = get_pathway_config().arrange_donate
+    if mode == "off":
+        return False
+    if mode == "on":
+        return True
+    import jax
+
+    platform = dev.platform if dev is not None else jax.default_backend()
+    return platform in ("tpu", "gpu")
+
+
+def _jit_grouped(n_cols: int, donate: bool = False):
     import jax
     import jax.numpy as jnp
 
-    @jax.jit
     def kernel(keys, diffs, cols):
         order = jnp.argsort(keys, stable=True)
         ks = keys[order]
@@ -108,12 +126,16 @@ def _jit_grouped(n_cols: int):
         )
         return order, ks, newg, counts, sums
 
+    jitted = (
+        jax.jit(kernel, donate_argnums=(0, 1, 2)) if donate else jax.jit(kernel)
+    )
     from pathway_tpu.observability import device as _dev_prof
 
-    return _dev_prof.traced_jit(f"engine.grouped/{n_cols}", kernel)
+    suffix = "/donated" if donate else ""
+    return _dev_prof.traced_jit(f"engine.grouped/{n_cols}{suffix}", jitted)
 
 
-_GROUPED_JIT: dict[int, Any] = {}
+_GROUPED_JIT: dict[tuple[int, bool], Any] = {}
 
 
 def numpy_grouped_sums(
@@ -146,10 +168,13 @@ def grouped_sums(
     """
     import jax
 
-    kern = _GROUPED_JIT.get(len(sum_cols))
-    if kern is None:
-        kern = _GROUPED_JIT[len(sum_cols)] = _jit_grouped(len(sum_cols))
     dev = _device()
+    donate = _donate_active(dev)
+    kern = _GROUPED_JIT.get((len(sum_cols), donate))
+    if kern is None:
+        kern = _GROUPED_JIT[(len(sum_cols), donate)] = _jit_grouped(
+            len(sum_cols), donate
+        )
     with jax_compat.enable_x64():
         args = (gkeys, diffs, tuple(sum_cols))
         if dev is not None:
@@ -238,22 +263,26 @@ def _persistent_cache() -> None:
         pass
 
 
-def _jit_probe():
+def _jit_probe(donate: bool = False):
     import jax
     import jax.numpy as jnp
 
-    @jax.jit
     def kernel(sorted_keys, q):
         lo = jnp.searchsorted(sorted_keys, q, side="left")
         hi = jnp.searchsorted(sorted_keys, q, side="right")
         return lo, hi - lo
 
+    # the query block is dead after the call (padded fresh per tick) — donate
+    # it on accelerator backends; the STATE side is never donated, it is the
+    # persistent arrangement re-probed across ticks
+    jitted = jax.jit(kernel, donate_argnums=(1,)) if donate else jax.jit(kernel)
     from pathway_tpu.observability import device as _dev_prof
 
-    return _dev_prof.traced_jit("engine.join_probe", kernel)
+    suffix = "/donated" if donate else ""
+    return _dev_prof.traced_jit(f"engine.join_probe{suffix}", jitted)
 
 
-_PROBE_JIT: Any = None
+_PROBE_JIT: dict[bool, Any] = {}
 
 
 _PAD_KEY = np.uint64(0xFFFFFFFFFFFFFFFF)
@@ -291,6 +320,42 @@ def _padded_state(arr: np.ndarray, bs: int) -> np.ndarray:
     return padded
 
 
+# Persistent device-resident arrangements (PATHWAY_ARRANGE_CACHE): a sorted
+# state segment is immutable between compactions, so its device copy is
+# uploaded once per compaction generation and every later tick probes the
+# SAME device buffer — the arrangement lives on device across ticks instead
+# of riding PCIe every call. Keyed by id() of the (host) padded array with a
+# liveness weakref (ids recycle after GC); one entry per (array, device).
+_DEV_CACHE: dict[tuple[int, str], tuple[Any, Any]] = {}
+_DEV_LOCK = threading.Lock()
+
+
+def _device_state(arr: np.ndarray, dev) -> Any:
+    from pathway_tpu.internals.config import get_pathway_config
+
+    if not get_pathway_config().arrange_device_cache:
+        import jax
+
+        return jax.device_put(arr, dev) if dev is not None else arr
+    import jax
+
+    key = (id(arr), str(dev))
+    with _DEV_LOCK:
+        ent = _DEV_CACHE.get(key)
+        if ent is not None and ent[0]() is arr:
+            return ent[1]
+    put = jax.device_put(arr, dev) if dev is not None else jax.device_put(arr)
+    with _DEV_LOCK:
+        dead = [k for k, (r, _) in _DEV_CACHE.items() if r() is None]
+        for k in dead:
+            del _DEV_CACHE[k]
+        try:
+            _DEV_CACHE[key] = (weakref.ref(arr), put)
+        except TypeError:  # pragma: no cover - non-weakref-able array subclass
+            pass
+    return put
+
+
 def join_probe(sorted_jk: np.ndarray, q_jk: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
     """Masked sorted-array probe (the hash-join inner kernel): for each probe
     key, the ``(lo, count)`` range of matches in the sorted state array —
@@ -305,10 +370,6 @@ def join_probe(sorted_jk: np.ndarray, q_jk: np.ndarray) -> tuple[np.ndarray, np.
     """
     import jax
 
-    global _PROBE_JIT
-    if _PROBE_JIT is None:
-        _persistent_cache()
-        _PROBE_JIT = _jit_probe()
     n_state, n_q = len(sorted_jk), len(q_jk)
     bs, bq = _bucket(n_state), _bucket(n_q)
     if bs != n_state:
@@ -322,11 +383,21 @@ def join_probe(sorted_jk: np.ndarray, q_jk: np.ndarray) -> tuple[np.ndarray, np.
     # auto mode adopts the probe on the CPU backend (the measured win);
     # explicit backends are honored as given
     dev = _device(force_cpu=flag() == "auto")
+    donate = _donate_active(dev)
+    kern = _PROBE_JIT.get(donate)
+    if kern is None:
+        _persistent_cache()
+        kern = _PROBE_JIT[donate] = _jit_probe(donate)
     with jax_compat.enable_x64():
-        args = (sorted_jk, q_jk_padded)
+        state_arg = _device_state(sorted_jk, dev)
+        q_arg = q_jk_padded
         if dev is not None:
-            args = jax.device_put(args, dev)
-        lo, cnt = _PROBE_JIT(*args)
+            q_arg = jax.device_put(q_arg, dev)
+        elif donate:
+            # donation only reaches XLA for device-committed args; the numpy
+            # fast path would silently copy anyway
+            q_arg = jax.device_put(q_arg)
+        lo, cnt = kern(state_arg, q_arg)
         # np.array (not asarray): JAX outputs are read-only; the pad
         # correction below mutates
         lo = np.array(lo[:n_q])

@@ -24,10 +24,15 @@ from pathway_tpu.engine.blocks import (
     concat_cols,
     consolidate,
     group_starts,
+    interleave_positions,
     make_column,
+    merge_consolidated,
+    net_input_batch,
+    scatter_cols,
 )
 from pathway_tpu.engine import jax_kernels
 from pathway_tpu.engine.colstore import ColumnarKeyedStore, ColumnarMultimap, SortedCounts
+from pathway_tpu.observability import engine_phases as _phases
 from pathway_tpu.engine.graph import END_OF_STREAM, SOLO, Node
 from pathway_tpu.engine.reducers_impl import ReducerImpl
 from pathway_tpu.internals.keys import combine_keys, row_keys, splitmix64
@@ -331,7 +336,7 @@ class StreamInputNode(Node):
             batch = DeltaBatch.from_rows(
                 keys, rows, self.columns, time, diffs=diffs, np_dtypes=self.np_dtypes
             )
-            return [consolidate(batch)]
+            return [net_input_batch(batch)]
         keys: list[int] = []
         diffs: list[int] = []
         rows: list[tuple] = []
@@ -360,7 +365,7 @@ class StreamInputNode(Node):
         batch = DeltaBatch.from_rows(
             keys, rows, self.columns, time, diffs=diffs, np_dtypes=self.np_dtypes
         )
-        return [consolidate(batch)]
+        return [net_input_batch(batch)]
 
 
 # ---------------------------------------------------------------------------- rowwise
@@ -1271,18 +1276,33 @@ class GroupByNode(Node):
         if remove.any() or add.any():
             keep = np.ones(len(sgk), dtype=bool)
             keep[pos[remove]] = False
-            gk2 = np.concatenate([sgk[keep], u_gk[add]])
-            o2 = np.argsort(gk2, kind="stable")
-            st["gk"] = gk2[o2]
-            st["n"] = np.concatenate([st["n"][keep], new_n[add]])[o2]
+            kept_gk = sgk[keep]
+            add_gk = u_gk[add]
+            # persistent arrangement discipline: both runs are sorted and
+            # DISJOINT (added groups were absent from state), so the merged
+            # arrangement is a two-way interleave by searchsorted positions —
+            # no argsort of the whole state per tick (the re-arrangement tax
+            # BASELINE §incremental attributes)
+            ia, ib = interleave_positions(kept_gk, add_gk)
+            total = len(kept_gk) + len(add_gk)
+            positions = [ia, ib]
+            gk_m = np.empty(total, dtype=np.uint64)
+            gk_m[ia] = kept_gk
+            gk_m[ib] = add_gk
+            st["gk"] = gk_m
+            n_m = np.empty(total, dtype=np.int64)
+            n_m[ia] = st["n"][keep]
+            n_m[ib] = new_n[add]
+            st["n"] = n_m
             for r in range(len(st["accs"])):
                 a, b = st["accs"][r][keep], new_accs[r][add]
                 dt = np.result_type(a.dtype, b.dtype)
-                st["accs"][r] = np.concatenate(
-                    [a.astype(dt, copy=False), b.astype(dt, copy=False)]
-                )[o2]
+                acc_m = np.empty(total, dtype=dt)
+                acc_m[ia] = a
+                acc_m[ib] = b
+                st["accs"][r] = acc_m
             st["gcols"] = [
-                concat_cols([sc[keep], bc[add]])[o2]
+                scatter_cols([sc[keep], bc[add]], positions, total)
                 for sc, bc in zip(st["gcols"], batch_gcols)
             ]
 
@@ -1324,6 +1344,13 @@ class GroupByNode(Node):
             }
 
     def process(self, inputs, time):
+        tok = _phases.start()
+        try:
+            return self._process_impl(inputs, time)
+        finally:
+            _phases.stop(tok, "groupby")
+
+    def _process_impl(self, inputs, time):
         batch = inputs[0]
         if batch is None or not len(batch):
             return []
@@ -1861,6 +1888,13 @@ class JoinNode(Node):
         return out
 
     def process(self, inputs, time):
+        tok = _phases.start()
+        try:
+            return self._process_impl(inputs, time)
+        finally:
+            _phases.stop(tok, "join")
+
+    def _process_impl(self, inputs, time):
         # Sides apply sequentially (left first), each probing the other's
         # state as of that moment — the batch-granular equivalent of the
         # reference's record-at-a-time symmetric join discipline.
@@ -1947,8 +1981,13 @@ class SubscribeNode(Node):
         if not self._pending:
             return
         batches, self._pending = self._pending, []
-        merged = concat_batches(batches)
-        net = consolidate(merged) if merged is not None else None
+        # incremental tick netting: each emission is consolidated at its own
+        # size and merged in O(overlap) — byte-identical to consolidating the
+        # tick's whole concatenation (the merge_consolidated ≡
+        # consolidate∘concat property, swept in tests/test_incremental_hot_path.py)
+        net = None
+        for b in batches:
+            net = merge_consolidated(net, consolidate(b))
         if net is not None and len(net) and self.on_change is not None:
             for key, diff, row in net.rows():
                 row_dict = dict(zip(self.columns, row))
@@ -1970,7 +2009,18 @@ class SubscribeNode(Node):
 
 class CaptureNode(Node):
     """Accumulates the final consolidated state (debug/compute_and_print) and the
-    full stream of deltas (stream assertions)."""
+    full stream of deltas (stream assertions).
+
+    The tick path is O(1) per block: batches are parked columnar (they ARE the
+    delta log) and folded lazily on access. ``current`` folds with one
+    vectorized last-op-wins pass — identical to sequential per-row apply,
+    since a key's final dict entry is exactly its LAST operation's effect
+    (earlier sets/pops are overwritten) — and builds row tuples only for keys
+    whose last op is an insert. ``deltas`` materializes row tuples only when a
+    stream assertion actually reads them. The per-row dict loop this replaces
+    was the single largest phase of the incremental bench (BASELINE
+    §incremental: ~half the tick under churny groupby retract+insert output).
+    """
 
     name = "capture"
 
@@ -1982,37 +2032,98 @@ class CaptureNode(Node):
     def __init__(self, columns: list[str]):
         super().__init__(n_inputs=1)
         self.columns = columns
-        self.current: dict[int, tuple] = {}
-        self.deltas: list[tuple[int, int, int, tuple]] = []  # (time, key, diff, row)
+        self._current: dict[int, tuple] = {}
+        self._deltas: list[tuple[int, int, int, tuple]] = []  # (time, key, diff, row)
+        self._batches: list[DeltaBatch] = []  # parked blocks, in arrival order
+        self._cur_upto = 0  # _batches fold cursor for _current
+        self._deltas_upto = 0  # _batches materialization cursor for _deltas
 
     def process(self, inputs, time):
         batch = inputs[0]
-        if batch is None:
+        if batch is None or batch.is_empty:
             return []
-        # vectorized: one C-speed transpose per block instead of a per-row
-        # python loop (the capture sink dominated the incremental bench)
-        keys = batch.keys.tolist()
-        diffs = batch.diffs.tolist()
-        if batch.data:
-            from pathway_tpu.engine.blocks import column_to_list
-
-            rows = list(zip(*(column_to_list(c) for c in batch.data.values())))
-        else:
-            rows = [()] * len(keys)
-        self.deltas.extend(zip([time] * len(keys), keys, diffs, rows))
-        if bool((batch.diffs > 0).all()):  # all inserts: one C-speed update
-            self.current.update(zip(keys, rows))
-        else:
-            # per-row, in batch order: drain() may CONCATENATE several
-            # same-tick emissions without re-consolidating, so an insert from
-            # one emission can precede a retract from a later one — a
-            # two-pass pops-then-inserts apply would resurrect such keys
-            for k, d, r in zip(keys, diffs, rows):
-                if d > 0:
-                    self.current[k] = r
-                else:
-                    self.current.pop(k, None)
+        self._batches.append(batch)
         return []
+
+    def _fold_current(self) -> None:
+        if self._cur_upto >= len(self._batches):
+            return
+        tok = _phases.start()
+        bs = self._batches[self._cur_upto :]
+        self._cur_upto = len(self._batches)
+        if len(bs) == 1:
+            keys, diffs, cols = bs[0].keys, bs[0].diffs, list(bs[0].data.values())
+        else:
+            keys = np.concatenate([b.keys for b in bs])
+            diffs = np.concatenate([b.diffs for b in bs])
+            cols = [
+                concat_cols([b.data[n] for b in bs]) for n in bs[0].data.keys()
+            ]
+        n = len(keys)
+        # last occurrence of each key across the concatenated (ordered) log
+        uniq, rev_first = np.unique(keys[::-1], return_index=True)
+        last = n - 1 - rev_first
+        set_mask = diffs[last] > 0
+        set_idx = last[set_mask]
+        if len(set_idx):
+            if cols:
+                rows = zip(*(column_to_list(c[set_idx]) for c in cols))
+            else:
+                rows = iter([()] * len(set_idx))
+            self._current.update(zip(uniq[set_mask].tolist(), rows))
+        pops = uniq[~set_mask]
+        if len(pops):
+            cur = self._current
+            for k in pops.tolist():
+                cur.pop(k, None)
+        self._prune_batches()
+        _phases.stop(tok, "capture")
+
+    def _materialize_deltas(self) -> None:
+        if self._deltas_upto >= len(self._batches):
+            return
+        bs = self._batches[self._deltas_upto :]
+        self._deltas_upto = len(self._batches)
+        for batch in bs:
+            keys = batch.keys.tolist()
+            diffs = batch.diffs.tolist()
+            if batch.data:
+                rows = list(zip(*(column_to_list(c) for c in batch.data.values())))
+            else:
+                rows = [()] * len(keys)
+            self._deltas.extend(zip([batch.time] * len(keys), keys, diffs, rows))
+        self._prune_batches()
+
+    def _prune_batches(self) -> None:
+        """Drop parked blocks both folds have consumed — a long-running job
+        that reads both ``current`` and ``deltas`` (e.g. every persistence
+        snapshot) must not hold the delta log twice."""
+        done = min(self._cur_upto, self._deltas_upto)
+        if done:
+            del self._batches[:done]
+            self._cur_upto -= done
+            self._deltas_upto -= done
+
+    @property
+    def current(self) -> dict[int, tuple]:
+        self._fold_current()
+        return self._current
+
+    @property
+    def deltas(self) -> list[tuple[int, int, int, tuple]]:
+        self._materialize_deltas()
+        return self._deltas
+
+    def snapshot_state(self) -> dict | None:
+        # materialized forms only: parked DeltaBatches stay out of snapshots
+        return {"current": dict(self.current), "deltas": list(self.deltas)}
+
+    def restore_state(self, state: dict) -> None:
+        self._current = dict(state.get("current", {}))
+        self._deltas = list(state.get("deltas", []))
+        self._batches = []
+        self._cur_upto = 0
+        self._deltas_upto = 0
 
 
 class CallbackOutputNode(Node):

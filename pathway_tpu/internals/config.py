@@ -137,6 +137,51 @@ class PathwayConfig:
         return _env_int("PATHWAY_DEVICE_EXCHANGE_MIN_ROWS", 4096)
 
     @property
+    def device_exchange_fused(self) -> str:
+        """Fused consolidate+exchange launch for the device plane: ``off`` =
+        consolidate on host, then exchange; ``auto``/``on`` = keyed delta
+        blocks are digest-netted (diffs segment-summed, net-zero rows
+        invalidated) INSIDE the same shard_map launch that re-shards them —
+        one kernel, one interconnect round, no intermediate host block."""
+        mode = os.environ.get("PATHWAY_DEVICE_EXCHANGE_FUSED", "auto").strip().lower()
+        if mode not in ("off", "auto", "on"):
+            raise ValueError(
+                f"PATHWAY_DEVICE_EXCHANGE_FUSED must be off/auto/on, got {mode!r}"
+            )
+        return mode
+
+    @property
+    def engine_phases(self) -> bool:
+        """Host-side per-phase tick attribution (consolidate / rehash / probe /
+        groupby / join / realloc / kernel / exchange / capture wall time):
+        read by ``benchmarks/engine_bench.py`` for the BENCH per-phase tick
+        breakdown. Off by default — instrumented sites pay one global read."""
+        return _env_bool("PATHWAY_ENGINE_PHASES", False)
+
+    @property
+    def arrange_device_cache(self) -> bool:
+        """Persistent device-resident arrangements for the jitted probe
+        kernel: sorted state segments are transferred once per compaction
+        generation and re-probed from device memory across ticks, instead of
+        re-uploading the arrangement every tick. On by default; ``0`` forces
+        the per-call transfer (debugging / memory-pressure escape hatch)."""
+        return _env_bool("PATHWAY_ARRANGE_CACHE", True)
+
+    @property
+    def arrange_donate(self) -> str:
+        """Buffer donation on the tick-loop jit entry points (probe queries,
+        grouped segment-sum inputs, exchange staging): ``auto`` = donate on
+        tpu/gpu backends where XLA reuses the buffer for outputs and skips a
+        copy, never on cpu (donation is ignored there and warns); ``on`` /
+        ``off`` force it."""
+        mode = os.environ.get("PATHWAY_ARRANGE_DONATE", "auto").strip().lower()
+        if mode not in ("off", "auto", "on"):
+            raise ValueError(
+                f"PATHWAY_ARRANGE_DONATE must be off/auto/on, got {mode!r}"
+            )
+        return mode
+
+    @property
     def microbatch(self) -> str:
         """Cross-tick accumulate-then-launch dispatch for ``is_batched`` UDFs
         (embedders/rerankers): ``off`` = one call per delta block (the r5
@@ -384,6 +429,10 @@ class PathwayConfig:
                 "profile",
                 "flight_dir",
                 "run_id",
+                "engine_phases",
+                "device_exchange_fused",
+                "arrange_device_cache",
+                "arrange_donate",
             )
         }
 

@@ -227,7 +227,7 @@ class _TimedInputNode(ops.StreamInputNode):
         return "push" in self.__dict__ or "push_many" in self.__dict__
 
     def poll(self, time: int):
-        from pathway_tpu.engine.blocks import DeltaBatch, consolidate
+        from pathway_tpu.engine.blocks import DeltaBatch, net_input_batch
         from pathway_tpu.engine.graph import END_OF_STREAM
 
         if self.upsert or self._hooked():
@@ -286,7 +286,7 @@ class _TimedInputNode(ops.StreamInputNode):
         )
         self.idx = emit_until
         self.polled_total += emit_until - sl.start
-        return [consolidate(batch)]
+        return [net_input_batch(batch)]
 
     @property
     def max_time(self) -> int:

@@ -24,7 +24,7 @@ from __future__ import annotations
 
 import secrets
 
-from pathway_tpu.observability import aggregate, device, metrics, spans
+from pathway_tpu.observability import aggregate, device, engine_phases, metrics, spans
 from pathway_tpu.observability.metrics import (
     BUCKET_BOUNDS_S,
     Histogram,
@@ -71,6 +71,11 @@ def install_from_env(runtime=None) -> Tracer | None:
     # device profiling plane (compile/pad/memory accounting, flight recorder,
     # profiler windows) — on by default, independent of PATHWAY_TRACE
     device.install_from_env(runtime)
+    # host-side per-phase tick attribution (PATHWAY_ENGINE_PHASES=on):
+    # consolidate/rehash/probe/realloc/kernel/exchange breakdown, read by
+    # engine_bench — totals persist across runs until reset() so one bench
+    # process can aggregate several pipelines
+    engine_phases.install_from_env()
     if _tracer is not None:
         try:
             _tracer.close(emit_root=False)
@@ -120,6 +125,7 @@ __all__ = [
     "current",
     "derive_trace_id",
     "device",
+    "engine_phases",
     "input_watermarks",
     "install_from_env",
     "metrics",

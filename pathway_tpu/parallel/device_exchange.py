@@ -40,25 +40,37 @@ from pathway_tpu.internals.keys import SHARD_MASK
 
 
 @lru_cache(maxsize=64)
-def _jitted_exchange(mesh, axis: str, n_cols: int, with_dest: bool = False):
+def _jitted_exchange(
+    mesh, axis: str, n_cols: int, with_dest: bool = False, fused: bool = False
+):
     """One compiled exchange per (mesh, axis, column-count): jit caches on
     function identity, so the per-tick call must reuse one closure or every
     tick would pay a full retrace+compile. ``with_dest`` adds an explicit
     per-row destination input (cluster plane: global shard mapped to a local
-    device index on host) instead of deriving it from the key bits."""
+    device index on host) instead of deriving it from the key bits.
+    ``fused`` appends the post-collective cancellation pass (ISSUE-6): an
+    extra (2, n) uint32 row-digest input rides along, and every (key, digest)
+    group whose diffs sum to ZERO comes back invalidated — in-flight
+    insert↔retract churn never reaches host memory. Groups with a nonzero
+    net keep ALL their rows, original diffs, arrival positions (join
+    arrangements carry multiplicity as physical rows; see the kernel
+    comment). The output is NOT consolidated or key-sorted."""
     import jax
     from jax.sharding import PartitionSpec as P
 
     n = mesh.shape[axis]
-    kern = _kernel(n, axis, with_dest)
+    kern = _kernel(n, axis, with_dest, fused)
     in_specs = [P(None, axis), P(axis), P(axis), [P(axis)] * n_cols]
     if with_dest:
         in_specs.append(P(axis))
+    if fused:
+        in_specs.append(P(None, axis))
     from pathway_tpu.jax_compat import shard_map
     from pathway_tpu.observability import device as _dev_prof
 
+    label = "device_exchange.fused_consolidate" if fused else "device_exchange.all_to_all"
     return _dev_prof.traced_jit(
-        "device_exchange.all_to_all",
+        label,
         jax.jit(
             shard_map(
                 kern,
@@ -71,11 +83,15 @@ def _jitted_exchange(mesh, axis: str, n_cols: int, with_dest: bool = False):
     )
 
 
-def _kernel(n_shards: int, axis: str, with_dest: bool = False):
+def _kernel(n_shards: int, axis: str, with_dest: bool = False, fused: bool = False):
     import jax
     import jax.numpy as jnp
 
-    def local(keys, diffs, valid, cols, dest=None):
+    def local(keys, diffs, valid, cols, *rest):
+        ri = 0
+        dest = rest[ri] if with_dest else None
+        ri += 1 if with_dest else 0
+        dig = rest[ri] if fused else None
         # keys arrive as uint32 pairs (hi, lo) — x64 stays off
         cap = keys.shape[1]
         hi, lo = keys[0], keys[1]
@@ -104,6 +120,9 @@ def _kernel(n_shards: int, axis: str, with_dest: bool = False):
         s_diff = stage(diffs, jnp.int32(0))
         s_valid = stage(valid, False)
         s_cols = [stage(c, jnp.zeros((), c.dtype)) for c in cols]
+        if fused:
+            s_dhi = stage(dig[0], jnp.uint32(0))
+            s_dlo = stage(dig[1], jnp.uint32(0))
 
         a2a = partial(jax.lax.all_to_all, axis_name=axis, split_axis=0, concat_axis=0)
         r_hi, r_lo = a2a(s_hi), a2a(s_lo)
@@ -111,17 +130,52 @@ def _kernel(n_shards: int, axis: str, with_dest: bool = False):
         r_cols = [a2a(c) for c in s_cols]
         # received: (n_shards, cap) blocks → flat (n_shards*cap) rows + mask
         flat = lambda x: x.reshape((n_shards * cap,) + x.shape[2:])  # noqa: E731
-        return (
-            jnp.stack([flat(r_hi), flat(r_lo)]),
-            flat(r_diff),
-            flat(r_valid),
-            [flat(c) for c in r_cols],
+        f_hi, f_lo = flat(r_hi), flat(r_lo)
+        f_diff, f_valid = flat(r_diff), flat(r_valid)
+        f_cols = [flat(c) for c in r_cols]
+        if not fused:
+            return jnp.stack([f_hi, f_lo]), f_diff, f_valid, f_cols
+        # fused consolidation — same launch, no host round-trip: the rows of
+        # one key only ever co-locate HERE (post-collective), so this is the
+        # earliest point deltas can net. Group by (key, digest) on a sorted
+        # VIEW, segment-sum the diffs, and invalidate every row of a group
+        # whose net is ZERO — the in-flight insert↔retract churn this fusion
+        # targets cancels before it ever reaches host memory. Groups with a
+        # nonzero net keep ALL their rows with their original diffs: stateful
+        # consumers (the join arrangement) carry multiplicity as physical
+        # rows, so collapsing a +1,+1 group to one diff-2 row would lose a
+        # copy of their state. Surviving rows stay in arrival order —
+        # byte-for-byte what the plain exchange delivers, minus cancelled
+        # pairs.
+        f_dhi, f_dlo = flat(a2a(s_dhi)), flat(a2a(s_dlo))
+        n_rows = f_hi.shape[0]
+        inv = (~f_valid).astype(jnp.uint32)
+        order = jnp.lexsort((f_dlo, f_dhi, f_lo, f_hi, inv))
+        hi_s, lo_s = f_hi[order], f_lo[order]
+        dhi_s, dlo_s = f_dhi[order], f_dlo[order]
+        v_s, d_s = f_valid[order], f_diff[order]
+        same_prev = jnp.concatenate(
+            [
+                jnp.zeros((1,), jnp.bool_),
+                (hi_s[1:] == hi_s[:-1])
+                & (lo_s[1:] == lo_s[:-1])
+                & (dhi_s[1:] == dhi_s[:-1])
+                & (dlo_s[1:] == dlo_s[:-1])
+                & (v_s[1:] == v_s[:-1]),
+            ]
         )
+        newg = ~same_prev
+        seg = jnp.cumsum(newg) - 1
+        sums = jax.ops.segment_sum(d_s, seg, num_segments=n_rows)
+        keep_s = v_s & (sums[seg] != 0)
+        out_valid = jnp.zeros_like(f_valid).at[order].set(keep_s)
+        out_diff = jnp.where(out_valid, f_diff, 0)
+        return jnp.stack([f_hi, f_lo]), out_diff, out_valid, f_cols
 
     return local
 
 
-def exchange_by_key(mesh, axis: str, keys, diffs, cols, valid, dest=None):
+def exchange_by_key(mesh, axis: str, keys, diffs, cols, valid, dest=None, dig=None):
     """Re-shard padded per-device blocks so every row lands on the device
     owning its key shard (host-plane parity: ``mesh.shard_of_keys``).
 
@@ -133,12 +187,24 @@ def exchange_by_key(mesh, axis: str, keys, diffs, cols, valid, dest=None):
     ``dest`` (int32, optional) routes each row to an explicit device index
     instead of its key-shard — the cluster plane uses this to map GLOBAL
     worker shards onto the process-local mesh.
+
+    ``dig`` (uint32 (2, n) row-digest pairs, optional) selects the FUSED
+    consolidate+exchange kernel: (key, digest) groups whose diffs net to
+    zero are invalidated in the same launch as the collective; surviving
+    rows keep their original diffs and arrival positions (cancel-only — the
+    output block is byte-identical to the plain exchange minus cancelled
+    pairs, not consolidated or re-sorted).
     """
+    fused = dig is not None
+    fn = _jitted_exchange(
+        mesh, axis, len(cols), with_dest=dest is not None, fused=fused
+    )
+    args = [keys, diffs, valid, cols]
     if dest is not None:
-        fn = _jitted_exchange(mesh, axis, len(cols), with_dest=True)
-        return fn(keys, diffs, valid, cols, dest)
-    fn = _jitted_exchange(mesh, axis, len(cols))
-    return fn(keys, diffs, valid, cols)
+        args.append(dest)
+    if fused:
+        args.append(dig)
+    return fn(*args)
 
 
 def split_keys_u64(keys: np.ndarray) -> np.ndarray:

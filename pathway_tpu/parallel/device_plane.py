@@ -79,6 +79,7 @@ class DeviceExchangePlane:
         # bench counters
         self.rows_exchanged = 0
         self.collectives = 0
+        self.rows_netted = 0  # rows removed by fused on-device consolidation
 
     # ------------------------------------------------------------ eligibility
     @staticmethod
@@ -120,6 +121,24 @@ class DeviceExchangePlane:
     @staticmethod
     def eligible(batch: DeltaBatch) -> bool:
         return all(c.dtype.kind in _FIXED_KINDS for c in batch.data.values())
+
+    def _fused_active(self) -> bool:
+        """Fused consolidate+exchange (PATHWAY_DEVICE_EXCHANGE_FUSED): keyed
+        deltas are digest-netted in the same launch that re-shards them.
+        ``auto`` engages on real accelerator meshes only (on the CPU mesh the
+        extra device sort is a measured negative, like the exchange itself);
+        ``on`` forces it for byte-identity suites."""
+        from pathway_tpu.internals.config import get_pathway_config
+
+        mode = get_pathway_config().device_exchange_fused
+        if mode == "off":
+            return False
+        if mode == "on":
+            return True
+        return (
+            self.mesh is not None
+            and self.mesh.devices.flat[0].platform != "cpu"
+        )
 
     def should_stage(self, batch: DeltaBatch) -> bool:
         if not self.available() or not self.eligible(batch):
@@ -171,6 +190,15 @@ class DeviceExchangePlane:
         return moved
 
     def _exchange_group(self, ci: int, port: int, entries: list, time: int, deliver) -> bool:
+        from pathway_tpu.observability import engine_phases as _phases
+
+        tok = _phases.start()
+        try:
+            return self._exchange_group_impl(ci, port, entries, time, deliver)
+        finally:
+            _phases.stop(tok, "exchange")
+
+    def _exchange_group_impl(self, ci: int, port: int, entries: list, time: int, deliver) -> bool:
         """One collective. ``entries`` = (mesh_slot, route_keys, batch,
         dest|None); dest (int32 local device indices) overrides key-shard
         routing — the cluster plane maps global shards to local slots."""
@@ -195,10 +223,12 @@ class DeviceExchangePlane:
         # global staging arrays: worker w's rows occupy [w*cap, w*cap+counts[w]).
         # Only `valid` needs zeroing — invalid slots of the others are masked
         # out at decode, so np.empty skips ~MBs of memset per flush
+        fused = self._fused_active()
         route = np.empty(n * cap, dtype=np.uint64)
         diffs = np.empty(n * cap, dtype=np.int32)
         valid = np.zeros(n * cap, dtype=bool)
         keys = np.empty(n * cap, dtype=np.uint64)
+        dig = np.empty(n * cap, dtype=np.uint64) if fused else None
         dest_buf = np.empty(n * cap, dtype=np.int32) if with_dest else None
         col_bufs: list[np.ndarray] = []
         for name in col_names:
@@ -215,6 +245,8 @@ class DeviceExchangePlane:
                 diffs[ofs : ofs + m] = b.diffs
                 keys[ofs : ofs + m] = b.keys
                 valid[ofs : ofs + m] = True
+                if fused:
+                    dig[ofs : ofs + m] = b.row_digest()
                 if with_dest:
                     dest_buf[ofs : ofs + m] = dest
                 bi = 0
@@ -232,6 +264,7 @@ class DeviceExchangePlane:
         out_route, out_diffs, out_valid, out_cols = exchange_by_key(
             self.mesh, self.axis, split_keys_u64(route), diffs, payload, valid,
             dest=dest_buf,
+            dig=split_keys_u64(dig) if fused else None,
         )
         self.collectives += 1
         self.rows_exchanged += total
@@ -239,6 +272,8 @@ class DeviceExchangePlane:
         out_valid = np.asarray(out_valid)
         out_diffs = np.asarray(out_diffs)
         out_cols = [np.asarray(c) for c in out_cols]
+        if fused:
+            self.rows_netted += total - int(out_valid.sum())
         per_dev = out_valid.shape[0] // n
         moved = False
         for d in range(n):

@@ -90,3 +90,91 @@ def test_exchanged_groupby_matches_host():
         if o:
             want[int(k)] = want.get(int(k), 0) + int(df) * int(v)
     assert got == want
+
+
+# ---------------------------------------------------- fused consolidate+exchange
+
+
+def test_fused_exchange_cancels_pairs_and_keeps_order():
+    """ISSUE-6 fused kernel: an in-flight insert↔retract pair of the same
+    (key, digest) nets to zero INSIDE the exchange launch; every surviving
+    row comes back at its arrival position with its original diff — i.e.
+    byte-identical to the plain exchange minus the cancelled pairs."""
+    n_dev, cap = 4, 32
+    mesh = _mesh(n_dev)
+    rng = np.random.default_rng(3)
+    n = n_dev * cap
+    keys = rng.integers(0, 50, n).astype(np.uint64)
+    vals = rng.integers(0, 1000, n).astype(np.uint64)
+    diffs = np.ones(n, dtype=np.int32)
+    # make exact cancellation pairs: row 2i+1 retracts row 2i
+    keys[1::2] = keys[::2]
+    vals[1::2] = vals[::2]
+    diffs[1::2] = -1
+    # …except every 4th pair, which stays live (same-sign duplicate)
+    diffs[1::8] = 1
+    valid = np.ones(n, dtype=bool)
+    valid[::17] = False
+    dig = split_keys_u64(vals * np.uint64(0x9E3779B97F4A7C15) + np.uint64(1))
+    payload = list(split_keys_u64(vals))
+
+    pk, pd, pv, pc = exchange_by_key(mesh, "data", split_keys_u64(keys), diffs, payload, valid)
+    fk, fd, fv, fc = exchange_by_key(
+        mesh, "data", split_keys_u64(keys), diffs, payload, valid, dig=dig
+    )
+    pk, pd, pv = np.asarray(pk), np.asarray(pd), np.asarray(pv)
+    fk, fd, fv = np.asarray(fk), np.asarray(fd), np.asarray(fv)
+    pc = [np.asarray(c) for c in pc]
+    fc = [np.asarray(c) for c in fc]
+
+    # keys/payload arrive in identical positions (arrival order untouched)
+    assert np.array_equal(pk, fk)
+    for a, b in zip(pc, fc):
+        assert np.array_equal(a, b)
+    # fused validity is a subset of plain validity; surviving rows keep diffs
+    assert not (fv & ~pv).any()
+    assert np.array_equal(fd[fv], pd[fv])
+    # something actually cancelled
+    assert int(fv.sum()) < int(pv.sum())
+
+    # per-(key, value) net diffs are preserved exactly
+    from collections import Counter
+
+    def nets(k2, d2, v2, c2):
+        kk = join_keys_u64(np.stack([k2[0], k2[1]]))[v2]
+        vv = join_keys_u64(np.stack([c2[0][v2], c2[1][v2]]))
+        c = Counter()
+        for a, b, d in zip(kk.tolist(), vv.tolist(), d2[v2].astype(np.int64).tolist()):
+            c[(a, b)] += d
+        return Counter({k: v for k, v in c.items() if v != 0})
+
+    assert nets(pk, pd, pv, pc) == nets(fk, fd, fv, fc)
+    # fused output has NO remaining exact-cancellation groups
+    f_nets = nets(fk, fd, fv, fc)
+    survivors = Counter()
+    kk = join_keys_u64(np.stack([fk[0], fk[1]]))[fv]
+    vv = join_keys_u64(np.stack([fc[0][fv], fc[1][fv]]))
+    for a, b in zip(kk.tolist(), vv.tolist()):
+        survivors[(a, b)] += 1
+    for pair in survivors:
+        assert pair in f_nets  # every surviving (key, value) group has net != 0
+
+
+def test_fused_exchange_same_sign_groups_keep_multiplicity():
+    """Same-sign duplicate rows must NOT collapse to a multi-diff row: join
+    arrangements carry multiplicity as physical rows."""
+    n_dev, cap = 4, 16
+    mesh = _mesh(n_dev)
+    n = n_dev * cap
+    keys = np.full(n, 7, dtype=np.uint64)
+    vals = np.full(n, 42, dtype=np.uint64)
+    diffs = np.ones(n, dtype=np.int32)
+    valid = np.ones(n, dtype=bool)
+    dig = split_keys_u64(vals)
+    fk, fd, fv, fc = exchange_by_key(
+        mesh, "data", split_keys_u64(keys), diffs, list(split_keys_u64(vals)), valid, dig=dig
+    )
+    fv = np.asarray(fv)
+    fd = np.asarray(fd)
+    assert int(fv.sum()) == n  # all survive as individual rows
+    assert (fd[fv] == 1).all()
