@@ -199,6 +199,48 @@ def profile(host, port, ticks, out_dir, status):
         raise click.ClickException(doc.get("error", "profile request failed"))
 
 
+@cli.command()
+@click.option("--host", type=str, default="127.0.0.1", help="monitoring server host")
+@click.option(
+    "--port",
+    type=int,
+    default=None,
+    help="monitoring server port (default PATHWAY_MONITORING_HTTP_PORT, 20000)",
+)
+@click.option("--sink", type=str, default=None, help="sink label, e.g. subscribe:7 (omit to list sinks)")
+@click.option("--key", type=str, default=None, help="output row key (decimal or 0x-hex)")
+def explain(host, port, sink, key):
+    """Ask a RUNNING pipeline why a sink row exists: walk the operator graph
+    backward through the lineage rings (``/explain`` endpoint) and print the
+    contributing input rows, operator path, and originating trace span ids.
+    Requires ``PATHWAY_AUDIT`` on (the default) and ``with_http_server=True``."""
+    import json as _json
+    import urllib.parse
+    import urllib.request
+
+    if port is None:
+        port = int(os.environ.get("PATHWAY_MONITORING_HTTP_PORT", "20000"))
+    qs = {}
+    if sink:
+        qs["sink"] = sink
+    if key is not None:
+        qs["key"] = key
+    url = f"http://{host}:{port}/explain"
+    if qs:
+        url += "?" + urllib.parse.urlencode(qs)
+    try:
+        body = urllib.request.urlopen(url, timeout=5).read().decode()
+    except OSError as e:
+        raise click.ClickException(
+            f"cannot reach monitoring server at {host}:{port}: {e} "
+            "(is the pipeline running with with_http_server=True?)"
+        ) from e
+    doc = _json.loads(body)
+    click.echo(_json.dumps(doc, indent=2))
+    if doc.get("ok") is False:
+        raise click.ClickException(doc.get("error", "explain request failed"))
+
+
 @cli.command(context_settings={"ignore_unknown_options": True})
 @click.option("--record-path", type=str, default="./record", help="recorded persistence root")
 @click.option(

@@ -19,6 +19,14 @@ from pathway_tpu.internals.keys import hash_column, row_keys, splitmix64
 from pathway_tpu.observability import engine_phases as _phases
 
 
+def _audit_current():
+    # late import: blocks is imported before the observability package's
+    # audit module finishes loading in some import orders
+    from pathway_tpu.observability.audit import current
+
+    return current()
+
+
 class DeltaBatch:
     __slots__ = ("keys", "diffs", "data", "time")
 
@@ -234,6 +242,11 @@ def consolidate(batch: DeltaBatch) -> DeltaBatch:
     tok = _phases.start()
     out = _consolidate_impl(batch)
     _phases.stop(tok, "consolidate")
+    aud = _audit_current()
+    if aud is not None:
+        # PATHWAY_AUDIT=full: verify the canonical/net-free contract on every
+        # consolidated batch (no-op in "on" mode — see check_canonical)
+        aud.check_canonical(out, "consolidate")
     return out
 
 

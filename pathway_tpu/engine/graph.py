@@ -19,7 +19,9 @@ import numpy as np
 
 from pathway_tpu.engine.blocks import DeltaBatch, concat_batches
 from pathway_tpu.internals.trace import run_annotated as _run_annotated
+from pathway_tpu.observability import audit as _audit
 from pathway_tpu.observability import device as _device_prof
+from pathway_tpu.resilience import faults as _faults
 
 END_OF_STREAM = np.iinfo(np.int64).max  # frontier value after all input closed
 
@@ -184,6 +186,10 @@ class Scheduler:
         """One topo pass; returns True if any node did work."""
         any_work = False
         trace = self._trace_active
+        aud = _audit.current()
+        # edge cardinality recording rides the audit plane's deterministic
+        # tick sample — unsampled ticks pay only this flag read
+        aud_note = aud is not None and aud.edge_sampled
         for node in self.graph.nodes:
             if not node.has_pending():
                 continue
@@ -216,6 +222,9 @@ class Scheduler:
                     _device_prof.stats().note_span_split(
                         f"sweep/{node.name}", max(0, elapsed_ns - dev_ns), dev_ns
                     )
+            if aud_note:
+                # audit plane: per-edge cardinality/selectivity counters
+                aud.note_edge(node, inputs, out)
             self._route(node, out)
             any_work = True
         return any_work
@@ -230,8 +239,19 @@ class Scheduler:
         tracer = self.tracer
         tick_token = tracer.begin_tick(time) if tracer is not None else None
         self._trace_active = tick_token is not None
+        aud = _audit.current()
+        if aud is not None:
+            aud.begin_tick(time)
         for node in self.graph.nodes:
-            self._route(node, _run_annotated(node, node.poll, time))
+            polled = _run_annotated(node, node.poll, time)
+            if polled:
+                # fault plan (flip_diff/drop_retract) corrupts BEFORE the
+                # audit monitors observe — the tripwire sees exactly what the
+                # engine will
+                polled = _faults.corrupt_polled(0, time, polled)
+                if aud is not None:
+                    aud.observe_input(node, polled, time)
+            self._route(node, polled)
         while self._sweep(time):
             pass
         # frontier phase: notify in topo order; emissions re-enter the same tick

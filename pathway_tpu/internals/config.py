@@ -373,6 +373,53 @@ class PathwayConfig:
         reports achieved FLOP/s without an MFU ratio."""
         return max(0.0, _env_float("PATHWAY_PROFILE_PEAK_TFLOPS", 0.0))
 
+    # ---- data-plane audit (observability plane, correctness side) -----------
+    @property
+    def audit(self) -> str:
+        """Data-plane correctness observability: ``on`` (default — invariant
+        monitors at operator edges, per-edge cardinality/selectivity gauges,
+        sampled shadow audits and the row-lineage rings, gated ≤5% overhead
+        like the device plane), ``full`` (additionally verifies every
+        consolidated batch is canonical/net-free and shadow-audits every
+        tick — investigation mode, ≤10%), or ``off``."""
+        raw = os.environ.get("PATHWAY_AUDIT", "on").strip().lower()
+        if raw in ("", "1", "true", "yes", "on"):
+            return "on"
+        if raw in ("0", "false", "no", "off"):
+            return "off"
+        if raw == "full":
+            return "full"
+        raise ValueError(f"PATHWAY_AUDIT must be off/on/full, got {raw!r}")
+
+    @property
+    def audit_sample(self) -> float:
+        """Fraction of TICKS shadow-audited in ``on`` mode (``full`` audits
+        every tick). Deterministic tick-hash sampling — the same hash the r8
+        trace sampler uses — so every cluster process audits the SAME ticks
+        and a divergence is attributable pod-wide."""
+        rate = _env_float("PATHWAY_AUDIT_SAMPLE", 0.0625)
+        if not 0.0 < rate <= 1.0:
+            raise ValueError(
+                f"PATHWAY_AUDIT_SAMPLE must be in (0, 1], got {rate}"
+            )
+        return rate
+
+    @property
+    def audit_keys(self) -> int:
+        """Per-edge key-multiplicity map bound for the invariant monitors.
+        A monitor whose map outgrows this stops folding (one structural
+        ``monitor_degraded`` event, never a crash) — the tripwire plane must
+        not become the memory leak it guards against."""
+        return max(1024, _env_int("PATHWAY_AUDIT_KEYS", 262144))
+
+    @property
+    def lineage_keys(self) -> int:
+        """Row-lineage provenance ring capacity per operator edge (output
+        keys remembered for ``/explain``; each keeps at most 8 contributing
+        input keys). 0 disables lineage recording while the audit monitors
+        stay live."""
+        return max(0, _env_int("PATHWAY_LINEAGE_KEYS", 4096))
+
     @property
     def flight_dir(self) -> str | None:
         """Post-mortem flight-recorder dump directory: on
@@ -427,6 +474,9 @@ class PathwayConfig:
                 "latency_slo_ms",
                 "monitoring_server",
                 "profile",
+                "audit",
+                "audit_sample",
+                "lineage_keys",
                 "flight_dir",
                 "run_id",
                 "engine_phases",

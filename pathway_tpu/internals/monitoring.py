@@ -125,6 +125,20 @@ def run_stats(runtime) -> dict[str, Any]:
     # ratios, memory attribution, host/device time split, recompile-storm
     # warnings (PATHWAY_PROFILE, on by default)
     stats["device"] = _obs.device.status_summary(runtime)
+    # data-plane audit (PATHWAY_AUDIT, on by default): invariant violations,
+    # shadow-audit divergences, per-operator-edge cardinality/selectivity,
+    # lineage ring occupancy
+    aud = _obs.audit.current()
+    stats["audit"] = (
+        aud.status_summary(runtime)
+        if aud is not None
+        else {"enabled": False, "mode": "off"}
+    )
+    # live error log: per-operator row-level failure counts (UDF raises under
+    # terminate_on_error=False — previously only visible via pw.global_error_log())
+    from pathway_tpu.internals import error_log as _error_log
+
+    stats["errors"] = _error_log.summary()
     tracer = _obs.current()
     if tracer is not None:
         stats["trace"] = {
@@ -270,6 +284,20 @@ def prometheus_text(runtime) -> str:
             )
     # ---- device profiling plane (compiles, pad waste, memory, FLOPs) --------
     lines.extend(_obs.device.prometheus_lines(runtime))
+    # ---- data-plane audit (edge cardinality, violations, divergences) -------
+    aud = _obs.audit.current()
+    if aud is not None:
+        lines.extend(aud.prometheus_lines(runtime))
+    # ---- per-operator row-level error counters ------------------------------
+    from pathway_tpu.internals import error_log as _error_log
+
+    err_counts = _error_log.operator_error_counts()
+    lines.append("# HELP pathway_operator_errors_total Row-level failures logged per operator")
+    lines.append("# TYPE pathway_operator_errors_total counter")
+    for op in sorted(err_counts):
+        lines.append(
+            f'pathway_operator_errors_total{{{_fmt_label(op=op)}}} {err_counts[op]}'
+        )
     return "\n".join(lines) + "\n"
 
 
@@ -293,6 +321,37 @@ def _profile_payload(query: str) -> bytes:
         pass
     path = unquote(qs["dir"][0]) if qs.get("dir") else None
     return json.dumps(_device.request_profile(ticks, path)).encode()
+
+
+def _explain_payload(runtime, query: str) -> bytes:
+    """``/explain?sink=<label>&key=<output key>``: walk the operator graph
+    backward from a sink row through the lineage rings — contributing input
+    rows, operator path, originating trace span ids. Requires the audit
+    plane's lineage store (``PATHWAY_AUDIT=on`` + ``PATHWAY_LINEAGE_KEYS>0``)."""
+    from urllib.parse import parse_qs, unquote
+
+    from pathway_tpu.observability import lineage as _lineage
+
+    qs = parse_qs(query)
+    store = _lineage.current()
+    if store is None:
+        return json.dumps(
+            {
+                "ok": False,
+                "error": "lineage is off (PATHWAY_AUDIT=off or PATHWAY_LINEAGE_KEYS=0)",
+            }
+        ).encode()
+    sink = unquote(qs["sink"][0]) if qs.get("sink") else None
+    if not sink:
+        return json.dumps(
+            {"ok": False, "error": "missing sink=", "sinks": store.sink_labels()}
+        ).encode()
+    try:
+        key = int(qs["key"][0], 0)
+    except (KeyError, ValueError, IndexError):
+        return json.dumps({"ok": False, "error": "missing or non-integer key="}).encode()
+    doc = store.explain(getattr(runtime, "scheduler", None), sink, key)
+    return json.dumps(doc, default=str).encode()
 
 
 def _trace_payload(query: str) -> bytes:
@@ -361,6 +420,9 @@ class MonitoringHttpServer:
                     ctype = "application/json"
                 elif path.rstrip("/") == "/profile":
                     body = _profile_payload(query)
+                    ctype = "application/json"
+                elif path.rstrip("/") == "/explain":
+                    body = _explain_payload(rt, query)
                     ctype = "application/json"
                 else:
                     self.send_response(404)

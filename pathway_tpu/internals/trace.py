@@ -8,8 +8,19 @@ from __future__ import annotations
 
 import os
 import sys
+import threading
 
 _PKG_DIR = os.path.dirname(os.path.dirname(os.path.abspath(__file__))) + os.sep
+
+# the engine node whose method is currently executing on this thread — lets
+# row-level failure reporting (``errors.report_error`` → the live error log)
+# attribute a UDF raise to its operator without threading ids through every
+# expression-VM call
+_tls = threading.local()
+
+
+def current_node():
+    return getattr(_tls, "node", None)
 
 
 def user_frame() -> tuple[str, int, str] | None:
@@ -51,9 +62,15 @@ def annotate(exc: BaseException, op_name: str, frame: tuple[str, int, str] | Non
 
 def run_annotated(node, method, *args):
     """Call an engine-node method, annotating any exception with the node's
-    user provenance — the ONE wrapper every runtime shares."""
+    user provenance — the ONE wrapper every runtime shares. Also pins the
+    node as this thread's current operator so row-level error reports
+    attribute to it."""
+    prev = getattr(_tls, "node", None)
+    _tls.node = node
     try:
         return method(*args)
     except Exception as e:
         annotate(e, node.name, getattr(node, "user_trace", None))
         raise
+    finally:
+        _tls.node = prev

@@ -24,7 +24,15 @@ from __future__ import annotations
 
 import secrets
 
-from pathway_tpu.observability import aggregate, device, engine_phases, metrics, spans
+from pathway_tpu.observability import (
+    aggregate,
+    audit,
+    device,
+    engine_phases,
+    lineage,
+    metrics,
+    spans,
+)
 from pathway_tpu.observability.metrics import (
     BUCKET_BOUNDS_S,
     Histogram,
@@ -71,6 +79,9 @@ def install_from_env(runtime=None) -> Tracer | None:
     # device profiling plane (compile/pad/memory accounting, flight recorder,
     # profiler windows) — on by default, independent of PATHWAY_TRACE
     device.install_from_env(runtime)
+    # data-plane audit (invariant monitors, cardinality gauges, shadow audits,
+    # row lineage) — on by default, independent of the other planes
+    audit.install_from_env(runtime)
     # host-side per-phase tick attribution (PATHWAY_ENGINE_PHASES=on):
     # consolidate/rehash/probe/realloc/kernel/exchange breakdown, read by
     # engine_bench — totals persist across runs until reset() so one bench
@@ -105,6 +116,7 @@ def shutdown() -> None:
     runs in ``finally`` blocks next to connector/server teardown."""
     global _tracer
     device.shutdown()
+    audit.shutdown()
     if _tracer is None:
         return
     try:
@@ -121,11 +133,13 @@ __all__ = [
     "SpanBuffer",
     "Tracer",
     "aggregate",
+    "audit",
     "backlog_gauges",
     "current",
     "derive_trace_id",
     "device",
     "engine_phases",
+    "lineage",
     "input_watermarks",
     "install_from_env",
     "metrics",

@@ -62,6 +62,13 @@ def local_summary(runtime) -> dict[str, Any]:
     dev = _device.heartbeat_summary()
     if dev is not None:
         summary["device"] = dev
+    # audit plane: violation/divergence counts ride the same heartbeat so a
+    # data-plane tripwire firing on ANY process is visible on the coordinator
+    from pathway_tpu.observability import audit as _audit
+
+    plane = _audit.current()
+    if plane is not None:
+        summary["audit"] = plane.heartbeat_summary()
     return summary
 
 
@@ -109,4 +116,11 @@ def cluster_status(runtime) -> dict[str, Any] | None:
     )
     if dev is not None:
         out["device"] = dev
+    from pathway_tpu.observability import audit as _audit
+
+    aud = _audit.merge_heartbeat_summaries(
+        [p.get("audit") for p in processes.values()]
+    )
+    if aud is not None:
+        out["audit"] = aud
     return out

@@ -42,6 +42,7 @@ from pathway_tpu.internals.config import get_pathway_config
 from pathway_tpu.internals.errors import OtherWorkerError
 from pathway_tpu.internals.logical import BuildContext, LogicalNode
 from pathway_tpu.internals.trace import run_annotated
+from pathway_tpu.observability import audit as _audit
 from pathway_tpu.parallel.mesh import shard_of_keys
 from pathway_tpu.resilience import faults as _faults
 
@@ -564,6 +565,8 @@ class ClusterRuntime:
     def _sweep_worker(self, lw: _LocalWorker, time: int) -> bool:
         any_work = False
         trace = self._trace_active
+        aud = _audit.current()
+        aud_note = aud is not None and aud.edge_sampled
         for node in lw.graph.nodes:
             with lw.lock:
                 if not node.has_pending():
@@ -595,6 +598,8 @@ class ClusterRuntime:
                     _dev_prof.stats().note_span_split(
                         f"sweep/{node.name}", max(0, w1 - w0 - dev_ns), dev_ns
                     )
+            if aud_note:
+                aud.note_edge(node, inputs, out)
             self._route(lw, node, out)
             any_work = True
         return any_work
@@ -729,17 +734,31 @@ class ClusterRuntime:
         # sources (local_source, r5) poll on every owning worker — including
         # workers hosted by peer processes. ``skip_poll`` is the drop_poll
         # fault-injection point: buffered events stay upstream for this tick.
+        aud = _audit.current()
+        if aud is not None:
+            aud.begin_tick(time)
+
+        def _polled(node):
+            polled = run_annotated(node, node.poll, time)
+            if polled:
+                # corruption faults (flip_diff/drop_retract) apply before the
+                # audit monitors observe, keyed by THIS process id
+                polled = _faults.corrupt_polled(self.pid, time, polled)
+                if aud is not None:
+                    aud.observe_input(node, polled, time)
+            return polled
+
         if not skip_poll and 0 in self.local_workers:
             lw0 = self.local_workers[0]
             for node in lw0.graph.nodes:
-                self._route(lw0, node, run_annotated(node, node.poll, time))
+                self._route(lw0, node, _polled(node))
         if not skip_poll:
             for gi, lw in self.local_workers.items():
                 if gi == 0:
                     continue
                 for node in lw.graph.nodes:
                     if getattr(node, "local_source", False):
-                        self._route(lw, node, run_annotated(node, node.poll, time))
+                        self._route(lw, node, _polled(node))
         self._round_until_quiescent(time, "sweep")
         while True:
             self._sync_watermarks()

@@ -43,7 +43,9 @@ from pathway_tpu.engine.blocks import DeltaBatch
 from pathway_tpu.engine.graph import BROADCAST, END_OF_STREAM, SOLO, EngineGraph, Node
 from pathway_tpu.internals.logical import BuildContext, LogicalNode
 from pathway_tpu.internals.trace import run_annotated
+from pathway_tpu.observability import audit as _audit
 from pathway_tpu.parallel.mesh import shard_of_keys
+from pathway_tpu.resilience import faults as _faults
 
 
 class _Worker:
@@ -175,6 +177,8 @@ class ShardedRuntime:
 
         any_work = False
         trace = self._trace_active
+        aud = _audit.current()
+        aud_note = aud is not None and aud.edge_sampled
         for node in worker.graph.nodes:
             with worker.lock:
                 if not node.has_pending():
@@ -206,6 +210,10 @@ class ShardedRuntime:
                     _dev_prof.stats().note_span_split(
                         f"sweep/{node.name}", max(0, w1 - w0 - dev_ns), dev_ns
                     )
+            if aud_note:
+                # per-edge cardinality counters (node instances are per-worker,
+                # so no cross-thread contention; read side sums by position)
+                aud.note_edge(node, inputs, out)
             if self._route(worker, node, out):
                 any_work = True
             any_work = any_work or any(b is not None for b in inputs)
@@ -268,13 +276,26 @@ class ShardedRuntime:
         # partitioned sources (``local_source``) poll on their OWN worker,
         # each subject owning a disjoint partition slice (r5: the SOLO-pin
         # kill, reference worker-architecture.md:36-47)
+        aud = _audit.current()
+        if aud is not None:
+            aud.begin_tick(time)
+
+        def _polled(w, node):
+            polled = run_annotated(node, node.poll, time)
+            if polled:
+                # corruption faults apply before the audit monitors observe
+                polled = _faults.corrupt_polled(0, time, polled)
+                if aud is not None:
+                    aud.observe_input(node, polled, time)
+            return polled
+
         w0 = self.workers[0]
         for node in w0.graph.nodes:
-            self._route(w0, node, run_annotated(node, node.poll, time))
+            self._route(w0, node, _polled(w0, node))
         for w in self.workers[1:]:
             for node in w.graph.nodes:
                 if getattr(node, "local_source", False):
-                    self._route(w, node, run_annotated(node, node.poll, time))
+                    self._route(w, node, _polled(w, node))
         while self._sweep_round(time):
             pass
         progressed = True
@@ -304,6 +325,7 @@ class ShardedRuntime:
         from pathway_tpu import flow as _flow
         from pathway_tpu import observability as _obs
 
+        _faults.install_from_env()  # fault plan resets per run (as in Runtime)
         _obs.install_from_env(self)
         _flow.install_from_env(self)  # before build: gates attach to inputs
         try:

@@ -587,6 +587,14 @@ class Persistence:
         recovering from operator snapshots replays only the log tail past the
         committed offsets, and the telemetry gauge lets tests (and operators)
         assert recovery was NOT a full-history recompute."""
+        if any(getattr(p, "replay_skip", 0) > 0 for p in self.inputs):
+            # suffix-only replay: the stream prefix is invisible to this run,
+            # so the audit plane's history-dependent monitors (multiplicity,
+            # shadow digests) must stand down or they would report legal
+            # retractions of pre-snapshot rows as violations
+            from pathway_tpu.observability import audit as _audit
+
+            _audit.note_history_truncated()
         replayed = 0
         for p in self.inputs:
             # `or 0`: replay() wrappers in tests may not return the count

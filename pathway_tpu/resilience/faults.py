@@ -11,6 +11,8 @@ Plan syntax (``;``-separated steps, each ``action:key=val,key=val``)::
     kill:proc=1,tick=40              # SIGKILL process 1 at the start of tick 40
     drop_poll:proc=0,tick=3,count=2  # drop connector polls for 2 ticks from t=3
     delay_barrier:proc=0,tick=4,ms=250,count=1  # delay 1 barrier call >=t4
+    flip_diff:proc=0,tick=3          # negate one polled row's diff sign at t>=3
+    drop_retract:proc=0,tick=3       # drop one polled retraction row at t>=3
 
 Semantics:
 
@@ -23,6 +25,15 @@ Semantics:
 - ``delay_barrier`` sleeps ``ms`` before the next ``count`` barrier
   participations at or after ``tick`` (simulates a slow/hung peer without
   killing it — the heartbeat-timeout detection path).
+- ``flip_diff`` / ``drop_retract`` are **data-plane corruptions** for testing
+  the audit plane (``PATHWAY_AUDIT``) end-to-end: applied to freshly polled
+  input blocks AFTER the connector/upsert machinery but BEFORE the audit
+  monitors and the engine see them — exactly where a real corruption bug
+  would live. ``flip_diff`` negates one row's diff sign (an insert becomes an
+  unmatched retraction); ``drop_retract`` removes one retraction row (its
+  insert stays live downstream forever). Each fires ``count`` times (default
+  1), at the first eligible poll at or after ``tick`` — ``drop_retract``
+  keeps scanning later ticks until a retraction actually appears.
 
 ``proc`` omitted means "any process". Every fired fault records a
 ``resilience.fault_*`` telemetry event (except ``kill``, which can only
@@ -55,7 +66,7 @@ class FaultSpec:
         return self.proc is None or self.proc == proc
 
 
-_ACTIONS = ("kill", "drop_poll", "delay_barrier")
+_ACTIONS = ("kill", "drop_poll", "delay_barrier", "flip_diff", "drop_retract")
 
 
 class FaultPlan:
@@ -138,6 +149,23 @@ class FaultPlan:
                 return s
         return None
 
+    def take_corruption(self, proc: int, tick: int, has_retract: bool) -> FaultSpec | None:
+        """One data-corruption firing for this (proc, tick), or None.
+        ``drop_retract`` only fires when the polled block actually carries a
+        retraction (it keeps waiting at later ticks otherwise)."""
+        for s in self.specs:
+            if (
+                s.action in ("flip_diff", "drop_retract")
+                and s.matches_proc(proc)
+                and tick >= s.tick
+                and s.remaining > 0
+            ):
+                if s.action == "drop_retract" and not has_retract:
+                    continue
+                s.remaining -= 1
+                return s
+        return None
+
     def take_barrier_delay(self, proc: int, tick: int) -> FaultSpec | None:
         for s in self.specs:
             if (
@@ -200,6 +228,53 @@ def on_tick_start(proc: int, tick: int) -> bool:
         record_event("resilience.fault_drop_poll", proc=proc, tick=tick)
         return True
     return False
+
+
+def corrupt_polled(proc: int, tick: int, batches: list) -> list:
+    """Data-corruption hook on freshly polled input blocks (every runtime's
+    poll loop): applies at most one ``flip_diff`` / ``drop_retract`` firing.
+    No plan (the overwhelmingly common case) is one attribute read."""
+    plan = _active
+    if plan is None or not batches:
+        return batches
+    has_retract = any(
+        b is not None and len(b) and bool((b.diffs < 0).any()) for b in batches
+    )
+    spec = plan.take_corruption(proc, tick, has_retract)
+    if spec is None:
+        return batches
+    import numpy as _np
+
+    from pathway_tpu.internals.telemetry import record_event
+
+    out = []
+    pending = spec.action
+    for b in batches:
+        if pending is None or b is None or not len(b):
+            out.append(b)
+            continue
+        if pending == "flip_diff":
+            diffs = b.diffs.copy()
+            diffs[0] = -diffs[0]
+            b = type(b)(b.keys, diffs, b.data, b.time)
+            record_event(
+                "resilience.fault_flip_diff", proc=proc, tick=tick,
+                key=int(b.keys[0]),
+            )
+            pending = None
+        elif pending == "drop_retract":
+            rets = _np.flatnonzero(b.diffs < 0)
+            if len(rets):
+                key = int(b.keys[rets[0]])
+                keep = _np.ones(len(b), dtype=bool)
+                keep[rets[0]] = False
+                b = b.take(_np.flatnonzero(keep))
+                record_event(
+                    "resilience.fault_drop_retract", proc=proc, tick=tick, key=key
+                )
+                pending = None
+        out.append(b)
+    return out
 
 
 def before_barrier(proc: int, tick: int) -> None:
