@@ -373,6 +373,55 @@ class PathwayConfig:
         reports achieved FLOP/s without an MFU ratio."""
         return max(0.0, _env_float("PATHWAY_PROFILE_PEAK_TFLOPS", 0.0))
 
+    # ---- index plane (serving-scale KNN) ------------------------------------
+    @property
+    def index_snapshot(self) -> str:
+        """Operator-snapshot discipline for external-index nodes: ``delta``
+        (default — persist an add/remove delta log per snapshot tick plus a
+        periodic compacted base, so a live 1M×384 index pays O(churn) per
+        interval instead of re-pickling ~1.5 GB) or ``whole`` (the pre-r13
+        whole-backend pickle, kept as an escape hatch)."""
+        raw = os.environ.get("PATHWAY_INDEX_SNAPSHOT", "delta").strip().lower()
+        if raw not in ("delta", "whole"):
+            raise ValueError(
+                f"PATHWAY_INDEX_SNAPSHOT must be delta/whole, got {raw!r}"
+            )
+        return raw
+
+    @property
+    def index_compact_frac(self) -> float:
+        """Delta-log compaction threshold: when the accumulated delta chunks
+        exceed this fraction of the base pickle's bytes, the next snapshot
+        tick writes a fresh compacted base and the covered delta chunks are
+        deleted after the manifest commit (the input-log trim discipline)."""
+        v = _env_float("PATHWAY_INDEX_COMPACT_FRAC", 0.5)
+        if v <= 0:
+            raise ValueError(f"PATHWAY_INDEX_COMPACT_FRAC must be > 0, got {v}")
+        return v
+
+    @property
+    def index_hot_rows(self) -> int:
+        """HBM-resident row bound of the tiered KNN index's hot shard
+        (``TieredKnnBackend``). The hot brute-force matrix is allocated at
+        this bound and never grows past it — fixed HBM regardless of corpus
+        size; everything else lives in the host IVF cold tier."""
+        n = _env_int("PATHWAY_INDEX_HOT_ROWS", 65536)
+        if n < 1:
+            raise ValueError(f"PATHWAY_INDEX_HOT_ROWS must be >= 1, got {n}")
+        return n
+
+    @property
+    def index_promote_hits(self) -> int:
+        """Cold-tier hit count (within one maintenance window) at which a row
+        becomes a promotion candidate for the hot shard."""
+        return max(1, _env_int("PATHWAY_INDEX_PROMOTE_HITS", 2))
+
+    @property
+    def index_maintain_batch(self) -> int:
+        """Max promotions (and matching LRU demotions) applied per between-tick
+        maintenance pass — bounds the off-query-path scatter work per tick."""
+        return max(1, _env_int("PATHWAY_INDEX_MAINTAIN_BATCH", 4096))
+
     # ---- data-plane audit (observability plane, correctness side) -----------
     @property
     def audit(self) -> str:
@@ -474,6 +523,8 @@ class PathwayConfig:
                 "latency_slo_ms",
                 "monitoring_server",
                 "profile",
+                "index_snapshot",
+                "index_hot_rows",
                 "audit",
                 "audit_sample",
                 "lineage_keys",

@@ -134,6 +134,11 @@ def run_stats(runtime) -> dict[str, Any]:
         if aud is not None
         else {"enabled": False, "mode": "off"}
     )
+    # tiered-index plane: hot/cold residency, exact hot-hit ratio and
+    # promotion/demotion counters (present only while a tiered index lives)
+    ts = _obs.device.index_tier_stats()
+    if ts is not None:
+        stats["index"] = ts
     # live error log: per-operator row-level failure counts (UDF raises under
     # terminate_on_error=False — previously only visible via pw.global_error_log())
     from pathway_tpu.internals import error_log as _error_log

@@ -438,6 +438,18 @@ def register_memory(obj: Any, component: str, fn: Callable[[Any], int]) -> None:
         _memory_providers.append((component, ref, fn))
 
 
+def index_tier_stats() -> dict | None:
+    """Aggregate tiered-index stats, or None when no tiered backend lives (or
+    the indexing stack can't import on this image). The ONE guarded accessor
+    behind both the /metrics lines here and the /status block in
+    ``internals.monitoring`` — keep the two surfaces from diverging."""
+    try:
+        from pathway_tpu.stdlib.indexing.tiered import tier_stats
+    except ImportError:
+        return None  # indexing stack absent on this image
+    return tier_stats()
+
+
 def memory_components() -> dict[str, int]:
     """component -> summed live bytes across registered owners."""
     out: dict[str, int] = {}
@@ -1011,4 +1023,22 @@ def prometheus_lines(runtime: Any = None) -> list[str]:
             lines.append(
                 f"pathway_mfu {round(flops_total / elapsed_s / (st.peak_tflops * 1e12), 6)}"
             )
+    # ---- tiered-index plane (hot HBM shard over host IVF cold tier) ---------
+    # hot/cold device bytes already ride pathway_device_bytes via the
+    # knn_hot/knn_cold memory components; these add serving-quality gauges
+    ts = index_tier_stats()
+    if ts is not None:
+        lines.append("# HELP pathway_index_hot_hit_ratio Fraction of emitted KNN hits served from the HBM hot shard")
+        lines.append("# TYPE pathway_index_hot_hit_ratio gauge")
+        lines.append(f"pathway_index_hot_hit_ratio {ts['hot_hit_ratio'] or 0.0}")
+        lines.append("# HELP pathway_index_promotions_total Rows promoted cold->hot by the tiered-index maintenance pass")
+        lines.append("# TYPE pathway_index_promotions_total counter")
+        lines.append(f"pathway_index_promotions_total {ts['promotions_total']}")
+        lines.append("# HELP pathway_index_demotions_total Rows demoted hot->cold by the tiered-index maintenance pass")
+        lines.append("# TYPE pathway_index_demotions_total counter")
+        lines.append(f"pathway_index_demotions_total {ts['demotions_total']}")
+        lines.append("# HELP pathway_index_tier_rows Resident rows per tier of the tiered KNN index")
+        lines.append("# TYPE pathway_index_tier_rows gauge")
+        lines.append(f'pathway_index_tier_rows{{tier="hot"}} {ts["hot_rows"]}')
+        lines.append(f'pathway_index_tier_rows{{tier="cold"}} {ts["cold_rows"]}')
     return lines

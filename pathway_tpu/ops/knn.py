@@ -68,6 +68,41 @@ def _key_bits_of(keys: Sequence[Any]) -> np.ndarray:
     return np.fromiter((_key_bits_one(k) for k in keys), dtype=np.uint32, count=len(keys))
 
 
+def _search_body(
+    vectors: jax.Array,      # [N, d]
+    norms_sq: jax.Array,     # [N] f32 (precomputed row |v|^2)
+    valid: jax.Array,        # [N] bool
+    key_bits: jax.Array,     # [N] uint32 (top 32 bits of each slot's key)
+    queries: jax.Array,      # [Q, d] f32
+    k: int,
+    metric: str,
+) -> tuple[jax.Array, jax.Array]:
+    """Shared scoring+selection body of ``_search_kernel`` and the tiered
+    index's candidate-rescore kernel — ONE formula for dot/norm/score, so a
+    row scores the same bits whichever launch touches it."""
+    dots = jnp.einsum(
+        "qd,nd->qn", queries, vectors, preferred_element_type=jnp.float32
+    )
+    if metric == KnnMetric.L2SQ.value:
+        qn = jnp.sum(queries * queries, axis=-1, keepdims=True)
+        # negative L2^2 so that "higher is better" uniformly
+        scores = -(qn + norms_sq[None, :] - 2.0 * dots)
+    elif metric == KnnMetric.COS.value:
+        qn = jnp.sqrt(jnp.sum(queries * queries, axis=-1, keepdims=True))
+        denom = jnp.maximum(qn * jnp.sqrt(norms_sq)[None, :], 1e-30)
+        scores = dots / denom
+    else:
+        scores = dots
+    scores = jnp.where(valid[None, :], scores, -jnp.inf)
+    if k == 0:  # static: resolved at trace time
+        q = queries.shape[0]
+        return (
+            jnp.zeros((q, 0), dtype=scores.dtype),
+            jnp.zeros((q, 0), dtype=jnp.int32),
+        )
+    return _canonical_select(scores, key_bits, k)
+
+
 @partial(_dev_prof.traced_jit, "knn.search")
 @partial(jax.jit, static_argnames=("k", "metric"))
 def _search_kernel(
@@ -91,27 +126,68 @@ def _search_kernel(
     whose keys collide in those 30 bits fall back to ``lax.top_k`` slot order —
     the worker-count byte-identity guarantee is therefore probabilistic,
     ~2^-30 per tied pair (keys are hashes, so bit collisions are uniform)."""
-    dots = jnp.einsum(
-        "qd,nd->qn", queries, vectors, preferred_element_type=jnp.float32
-    )
-    if metric == KnnMetric.L2SQ.value:
-        qn = jnp.sum(queries * queries, axis=-1, keepdims=True)
-        # negative L2^2 so that "higher is better" uniformly
-        scores = -(qn + norms_sq[None, :] - 2.0 * dots)
-    elif metric == KnnMetric.COS.value:
-        qn = jnp.sqrt(jnp.sum(queries * queries, axis=-1, keepdims=True))
-        denom = jnp.maximum(qn * jnp.sqrt(norms_sq)[None, :], 1e-30)
-        scores = dots / denom
+    return _search_body(vectors, norms_sq, valid, key_bits, queries, k, metric)
+
+
+@partial(_dev_prof.traced_jit, "knn.rescore")
+@partial(jax.jit, static_argnames=("k", "metric"))
+def _rescore_kernel(
+    rows: jax.Array,       # [C, d] f32 candidate matrix (padded)
+    valid: jax.Array,      # [C] bool (padding rows False)
+    key_bits: jax.Array,   # [C] uint32
+    queries: jax.Array,    # [Q, d] f32
+    k: int,
+    metric: str,
+) -> tuple[jax.Array, jax.Array]:
+    """Exact top-k over an ad-hoc candidate matrix. Row norms are computed on
+    device with the same expression ``_scatter_block`` uses at ingest, and
+    scoring/selection is ``_search_body`` — so a candidate's score here is the
+    same bits the resident-index search produces for it."""
+    rows32 = rows.astype(jnp.float32)
+    norms_sq = jnp.sum(rows32 * rows32, axis=-1)
+    return _search_body(rows, norms_sq, valid, key_bits, queries, k, metric)
+
+
+def exact_rescore(
+    rows: np.ndarray,          # [m, d] f32 candidate vectors (raw, un-normalized)
+    keys: Sequence[Any],       # len m candidate keys
+    queries: np.ndarray | jax.Array,  # [Q, d]
+    k: int,
+    metric: str = "cos",
+) -> list[list[tuple[Any, float]]]:
+    """Per-query exact top-k over an explicit candidate set, via the device
+    kernel (einsum + canonical select). The candidate count pads to a
+    power-of-two capacity (padded slots invalid) so the compile cache stays a
+    small closed set under varying candidate volumes. Used by the tiered
+    index to score cold-tier candidates with the same math as the HBM shard."""
+    m = len(keys)
+    if m == 0:
+        q = np.atleast_2d(np.asarray(queries))
+        return [[] for _ in range(q.shape[0])]
+    cap = _pad_to_capacity(m)
+    mat = np.zeros((cap, rows.shape[1]), dtype=np.float32)
+    mat[:m] = rows
+    valid = np.zeros(cap, dtype=bool)
+    valid[:m] = True
+    bits = np.zeros(cap, dtype=np.uint32)
+    bits[:m] = _key_bits_of(list(keys))
+    q = queries
+    if isinstance(q, jax.Array):
+        # same coercion as the np path: the bit-identical-score guarantee
+        # holds only for f32 [Q, d] operands
+        if q.ndim == 1:
+            q = q[None, :]
+        q = q.astype(jnp.float32)
     else:
-        scores = dots
-    scores = jnp.where(valid[None, :], scores, -jnp.inf)
-    if k == 0:  # static: resolved at trace time
-        q = queries.shape[0]
-        return (
-            jnp.zeros((q, 0), dtype=scores.dtype),
-            jnp.zeros((q, 0), dtype=jnp.int32),
-        )
-    return _canonical_select(scores, key_bits, k)
+        q = jnp.asarray(np.atleast_2d(np.asarray(q, np.float32)))
+    scores, ids = _rescore_kernel(
+        jnp.asarray(mat), jnp.asarray(valid), jnp.asarray(bits), q,
+        k=min(k, cap), metric=metric,
+    )
+    scores_np = np.asarray(scores)
+    ids_np = np.asarray(ids)
+    slot_to_key = {i: key for i, key in enumerate(keys)}
+    return _decode_hits(scores_np, ids_np, slot_to_key, k)
 
 
 def _topk_rows(x: jax.Array, k: int) -> tuple[jax.Array, jax.Array]:
@@ -251,10 +327,12 @@ class BruteForceKnnIndex:
         metric: KnnMetric | str = KnnMetric.COS,
         capacity: int = _MIN_CAPACITY,
         dtype: Any = jnp.float32,
+        component: str = "knn_index",
     ):
         self.dimension = dimension
         self.metric = KnnMetric(metric) if not isinstance(metric, KnnMetric) else metric
         self.dtype = dtype
+        self._mem_component = component
         capacity = _pad_to_capacity(capacity)
         self._vectors = jnp.zeros((capacity, dimension), dtype=dtype)
         self._norms_sq = jnp.zeros((capacity,), dtype=jnp.float32)
@@ -275,8 +353,9 @@ class BruteForceKnnIndex:
         # pack them into ONE host→device transfer
         self._pending_device: list[tuple[np.ndarray, Any, np.ndarray]] = []
         # memory attribution: index shards appear as
-        # pathway_device_bytes{component="knn_index"} while this instance lives
-        _dev_prof.register_memory(self, "knn_index", lambda ix: ix.device_bytes())
+        # pathway_device_bytes{component="knn_index"} while this instance
+        # lives (tiered indexes relabel their hot shard "knn_hot")
+        _dev_prof.register_memory(self, component, lambda ix: ix.device_bytes())
 
     def device_bytes(self) -> int:
         """Live device bytes of the index arrays (vectors + norms + validity +
@@ -313,6 +392,13 @@ class BruteForceKnnIndex:
             slots = np.fromiter(self._slot_to_key, dtype=np.int64, count=len(self._slot_to_key))
             bits[slots] = _key_bits_of(list(self._slot_to_key.values()))
         self._key_bits = jnp.asarray(bits)
+        # a restored index re-attributes its HBM bytes (weak registration does
+        # not survive pickling)
+        _dev_prof.register_memory(
+            self,
+            self.__dict__.get("_mem_component", "knn_index"),
+            lambda ix: ix.device_bytes(),
+        )
 
     # -- capacity ------------------------------------------------------------
     @property

@@ -232,6 +232,45 @@ class _PersistedInput:
         self.node.flow_ungated = True
 
 
+class SnapshotStore:
+    """Stable-keyed auxiliary chunk store for INCREMENTAL operator snapshots.
+
+    Generation entries are deleted wholesale when the next generation commits,
+    which forces every node to re-pickle its entire state each snapshot tick —
+    exactly the ~1.5 GB/interval tax the index plane pays at 1M×384 (VERDICT
+    "What's weak" #4). Nodes that declare ``uses_snapshot_store = True``
+    instead write named chunks under a per-(worker, node) prefix that SURVIVES
+    generations (a compacted base + delta chunks), and their generation entry
+    holds only a small manifest naming the chunks it needs.
+
+    Durability contract mirrors the input-log path: chunks are written in
+    ``save_shards`` (before the manifest commit), and chunks no longer
+    referenced by the new state are deleted only AFTER the commit is durable
+    (``_OperatorSnapshots.flush_aux_gc``) — a crash mid-save leaves the
+    previous generation's chunk set fully intact, and a crash between commit
+    and GC only delays deletion.
+    """
+
+    def __init__(self, backend: KVBackend, prefix: str):
+        self.backend = backend
+        self.prefix = prefix
+        self.referenced: set[str] = set()
+        self.put_bytes = 0  # bytes written this snapshot tick (tests/bench)
+
+    def put_chunk(self, name: str, payload: bytes) -> None:
+        key = self.prefix + name
+        self.backend.put(key, payload)
+        self.referenced.add(key)
+        self.put_bytes += len(payload)
+
+    def get_chunk(self, name: str) -> bytes | None:
+        return self.backend.get(self.prefix + name)
+
+    def reference(self, name: str) -> None:
+        """Mark a chunk as still needed by the state being saved (kept at GC)."""
+        self.referenced.add(self.prefix + name)
+
+
 class _OperatorSnapshots:
     """Generation-addressed node-state store + manifest."""
 
@@ -241,6 +280,8 @@ class _OperatorSnapshots:
         self.manifest = self._load_manifest()
         self.gen = (self.manifest["gen"] + 1) if self.manifest else 0
         self._last_save = _time.monotonic()
+        # SnapshotStores whose unreferenced chunks await post-commit deletion
+        self._pending_gc: list[SnapshotStore] = []
 
     def _load_manifest(self) -> dict | None:
         raw = self.backend.get(_MANIFEST)
@@ -283,7 +324,20 @@ class _OperatorSnapshots:
                 )
                 raw = self.backend.get(key)
                 if raw is not None:
-                    node.restore_state(pickle.loads(raw))
+                    state = pickle.loads(raw)
+                    if getattr(node, "uses_snapshot_store", False):
+                        node.restore_state_store(state, self._aux_store(w, node))
+                    else:
+                        node.restore_state(state)
+
+    def _aux_store(self, worker: int, node) -> SnapshotStore:
+        """Generation-independent chunk store for one (worker, node) shard.
+        ``operators/aux/`` is disjoint from ``operators/gen_*/`` so the
+        generation GC in :meth:`commit` never touches it."""
+        return SnapshotStore(
+            self.backend,
+            f"operators/aux/worker_{worker:03d}/node_{node.node_index:05d}/",
+        )
 
     def save_shards(self, worker_nodes: dict[int, list]) -> None:
         """Write this process's worker shards for the CURRENT generation
@@ -291,13 +345,29 @@ class _OperatorSnapshots:
         g = self.gen
         for w, nodes in worker_nodes.items():
             for node in nodes:
-                state = node.snapshot_state()
+                if getattr(node, "uses_snapshot_store", False):
+                    store = self._aux_store(w, node)
+                    state = node.snapshot_state_store(store)
+                    self._pending_gc.append(store)
+                else:
+                    state = node.snapshot_state()
                 if state is None:
                     continue
                 self.backend.put(
                     f"operators/gen_{g:08d}/worker_{w:03d}/node_{node.node_index:05d}",
                     pickle.dumps(state),
                 )
+
+    def flush_aux_gc(self) -> None:
+        """Delete auxiliary chunks no longer referenced by the committed
+        state (covered delta chunks after a base compaction, plus any orphans
+        from a crash mid-save). Called only after the manifest commit is
+        durable — before that, the previous generation still needs them."""
+        for store in self._pending_gc:
+            for k in self.backend.list_keys(store.prefix):
+                if k not in store.referenced:
+                    self.backend.delete(k)
+        self._pending_gc = []
 
     def commit(
         self,
@@ -348,6 +418,7 @@ class _OperatorSnapshots:
         """
         self.save_shards(worker_nodes)
         self.commit(node_names, input_offsets, tick, len(worker_nodes))
+        self.flush_aux_gc()
         self.advance()
 
 
@@ -480,6 +551,19 @@ class Persistence:
             else:
                 self._worker_nodes = {0: list(ctx.graph.nodes)}
                 self._total_workers = 1
+            # nodes with incremental (chunk-store) snapshots start recording
+            # their mutation delta logs only under operator persistence WITH a
+            # periodic snapshot cadence — a non-persisted run (or a
+            # snapshot-at-close run, interval <= 0, whose single save could
+            # otherwise sit behind an O(total mutations) log) must not
+            # accumulate one; at-close saves write a fresh compacted base
+            # instead. Enabled BEFORE restore/replay so post-snapshot replay
+            # ops are captured for the next delta chunk.
+            if self.config.snapshot_interval_ms > 0:
+                for nodes in self._worker_nodes.values():
+                    for n in nodes:
+                        if getattr(n, "uses_snapshot_store", False):
+                            n.snapshot_log_enabled = True
             self._node_names = [
                 (
                     n.name,
@@ -720,6 +804,7 @@ class Persistence:
         )
         for p in self.inputs:
             p.trim(decision["offsets"].get(p.pid, 0))
+        self.opsnap.flush_aux_gc()  # each process GCs its own shards' chunks
         self.opsnap.advance()
 
     def _commit_epoch(self, time: int) -> None:

@@ -13,6 +13,7 @@ from pathway_tpu.stdlib.indexing.nearest_neighbors import (
     DistanceMetric,
     IvfFlatKnn,
     LshKnn,
+    TieredKnn,
     UsearchKnn,
 )
 from pathway_tpu.stdlib.indexing.retrievers import (
@@ -22,8 +23,10 @@ from pathway_tpu.stdlib.indexing.retrievers import (
     IvfFlatKnnFactory,
     LshKnnFactory,
     TantivyBM25Factory,
+    TieredKnnFactory,
     UsearchKnnFactory,
 )
+from pathway_tpu.stdlib.indexing.tiered import TieredKnnBackend, tier_stats
 
 __all__ = [
     "AbstractRetrieverFactory",
@@ -41,6 +44,10 @@ __all__ = [
     "LshKnnFactory",
     "TantivyBM25",
     "TantivyBM25Factory",
+    "TieredKnn",
+    "TieredKnnBackend",
+    "TieredKnnFactory",
     "UsearchKnn",
     "UsearchKnnFactory",
+    "tier_stats",
 ]

@@ -84,6 +84,10 @@ class IvfFlatBackend(IndexBackend):
         self._csr_pos: dict[int, int] = {}  # slot -> csr row
         self._extra: set[int] = set()  # slots added since the last rebuild
         self._csr_dead = 0
+        #: per-(query, list) candidate over-fetch multiplier so post-filtering
+        #: still fills k; callers that filter elsewhere (the tiered backend
+        #: rescores and filters at its merge) set 1 to skip the margin
+        self.post_filter_mult = 10
 
     # ------------------------------------------------------------------ sizing
     def __len__(self) -> int:
@@ -300,8 +304,8 @@ class IvfFlatBackend(IndexBackend):
         probe = np.argpartition(-cscores, min(nprobe, nlist) - 1, axis=1)[:, :nprobe]
         nq = len(qs)
         # over-fetch per (query, list) so post-filtering still fills k (same
-        # 10x factor as VectorBackend.search)
-        fetch = max(ks, default=1) * 10
+        # 10x factor as VectorBackend.search; 1 when the caller filters later)
+        fetch = max(ks, default=1) * getattr(self, "post_filter_mult", 10)
         # batch by LIST across queries: one slice matmul per probed list (big
         # contiguous GEMMs instead of per-query gathers)
         q_of_list: dict[int, list[int]] = {}

@@ -136,6 +136,49 @@ class IvfFlatKnn(InnerIndex):
         self.metric = metric_val
 
 
+class TieredKnn(InnerIndex):
+    """Tiered KNN (``indexing/tiered.py``): a bounded hot shard in HBM
+    (recently added + frequently hit rows, ``PATHWAY_INDEX_HOT_ROWS``) over a
+    host-resident IVF cold tier, with batched promotion/demotion between
+    ticks — serves corpora far beyond HBM capacity on a fixed device-memory
+    budget."""
+
+    def __init__(
+        self,
+        data_column: ColumnReference,
+        dimensions: int,
+        *,
+        metric: DistanceMetric | str = DistanceMetric.COS,
+        metadata_column: ColumnExpression | None = None,
+        embedder=None,
+        hot_rows: int | None = None,
+        nlist: int | None = None,
+        nprobe: int | None = None,
+        min_train: int = 4096,
+        promote_hits: int | None = None,
+    ):
+        from pathway_tpu.stdlib.indexing.tiered import TieredKnnBackend
+
+        metric_val = metric.value if isinstance(metric, DistanceMetric) else str(metric)
+        transform = _embedder_transform(embedder)
+        super().__init__(
+            data_column,
+            metadata_column=metadata_column,
+            backend_factory=lambda: TieredKnnBackend(
+                dimension=dimensions,
+                metric=metric_val,
+                hot_rows=hot_rows,
+                nlist=nlist,
+                nprobe=nprobe,
+                min_train=min_train,
+                promote_hits=promote_hits,
+            ),
+            item_transform=transform,
+        )
+        self.dimensions = dimensions
+        self.metric = metric_val
+
+
 class UsearchKnn(IvfFlatKnn):
     """Reference API parity for the ANN index name. Routed to :class:`IvfFlatKnn`
     (VERDICT r5 #7): a user asking for the approximate index by the reference

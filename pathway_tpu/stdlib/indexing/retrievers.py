@@ -95,6 +95,35 @@ class IvfFlatKnnFactory(BruteForceKnnFactory):
 
 
 @dataclass
+class TieredKnnFactory(BruteForceKnnFactory):
+    """Tiered retriever (``indexing/tiered.py``): bounded HBM hot shard over a
+    host IVF cold tier — fixed device memory at any corpus size."""
+
+    hot_rows: int | None = None
+    nlist: int | None = None
+    nprobe: int | None = None
+    min_train: int = 4096
+    promote_hits: int | None = None
+
+    def build_index(self, data_column, data_table, metadata_column=None) -> DataIndex:
+        from pathway_tpu.stdlib.indexing.nearest_neighbors import TieredKnn
+
+        inner = TieredKnn(
+            data_column,
+            self._resolved_dimensions(),
+            metric=self.metric,
+            metadata_column=metadata_column,
+            embedder=self.embedder,
+            hot_rows=self.hot_rows,
+            nlist=self.nlist,
+            nprobe=self.nprobe,
+            min_train=self.min_train,
+            promote_hits=self.promote_hits,
+        )
+        return DataIndex(data_table, inner)
+
+
+@dataclass
 class TantivyBM25Factory(AbstractRetrieverFactory):
     ram_budget: int | None = None
     in_memory_index: bool = True

@@ -179,6 +179,51 @@ def test_drop_retract_detected_on_upsert_session(monkeypatch):
     assert v["key"] is not None and v["tick"] is not None
 
 
+def test_flip_diff_on_index_input_edge_with_tiered_backend_live(monkeypatch):
+    """ISSUE 9 satellite: index add/remove deltas ride the audit plane — a
+    flip_diff fault on the index DOCS input edge is detected within one tick
+    while a TieredKnnBackend serves the index (whose tolerant remove() keeps
+    the dataflow alive so the tripwire, not a crash, reports the corruption)."""
+    from pathway_tpu.stdlib.indexing import TieredKnnFactory
+
+    monkeypatch.setenv("PATHWAY_FAULT_PLAN", "flip_diff:proc=0,tick=2")
+    G.clear()
+    rng = np.random.default_rng(21)
+    vecs = rng.normal(size=(48, 8)).astype(np.float32)
+    docs = pw.debug.table_from_rows(
+        pw.schema_from_types(emb=np.ndarray),
+        [(v, i // 8, 1) for i, v in enumerate(vecs)],  # 6 ticks of 8 docs
+        is_stream=True,
+    )
+    index = TieredKnnFactory(dimensions=8, hot_rows=8, min_train=10**9).build_index(
+        docs.emb, docs
+    )
+    qs = pw.debug.table_from_rows(
+        pw.schema_from_types(emb=np.ndarray), [(vecs[3],)]
+    )
+    r = index.inner_index.query_as_of_now(qs.emb, number_of_matches=2)
+    replies: list = []
+    pw.io.subscribe(
+        r,
+        on_change=lambda key, row, time, is_addition: replies.append(row)
+        if is_addition
+        else None,
+    )
+    pw.run(monitoring_level="none")
+    plane = audit_mod.current()
+    assert plane.violation_counts.get("negative_multiplicity", 0) >= 1
+    v = next(v for v in plane.violations if v["kind"] == "negative_multiplicity")
+    # detected at the corrupted docs input edge, at the corruption tick
+    assert v["tick"] == 2 and v["key"] is not None
+    assert v["operator"].startswith("stream_fixture")
+    # the index kept serving (the corrupt retraction poisoned only its row)
+    assert replies, "index replies must survive the corrupted edge"
+    from pathway_tpu.stdlib.indexing.tiered import tier_stats
+
+    ts = tier_stats()
+    assert ts is not None and ts["hits_total"] >= 2
+
+
 _CLUSTER_SCRIPT = textwrap.dedent(
     """
     import json, sys
