@@ -1,0 +1,652 @@
+"""Serving benchmark: the REST front door on the Adaptive-RAG query loop.
+
+The acceptance surface of the r14 tentpole (production query-serving plane):
+
+- **Arrival-driven vs fixed-poll latency** (the headline): sequential
+  single-request p50/p99 through the full embed→KNN→rerank chain with
+  ``PATHWAY_SERVE_TICK=arrival`` (query arrival wakes the tick loop through a
+  2 ms coalesce window) vs ``poll`` (the pre-r14 behavior: every request
+  waits out the autocommit interval). Gate: arrival p50 ≥2× lower, responses
+  byte-identical between modes.
+- **Coalesced concurrent throughput**: C closed-loop HTTP clients against one
+  route; concurrent requests coalesce into shared engine ticks and ride the
+  r6/r9 microbatch path. Gate: ≥80% of the direct-encode ceiling (the same
+  encode→search→rerank work, driven directly in device batches of the client
+  concurrency, no HTTP/engine in the path).
+- **10× bulk-ingest flood**: with ``PATHWAY_FLOW=on``, a bulk-class document
+  stream floods the live index at 10× the query row rate while interactive
+  clients keep querying. Gate (the r9 SLO multiple): flooded interactive p99
+  within 3× unloaded.
+- **Regression gate** (r11 discipline): ``serving_qps`` compares against the
+  last committed ``BENCH_r*.json`` carrying it; drops past ``GATE_DROP_PCT``
+  warn locally and exit 1 under ``BENCH_MODE=1``, downgraded to a warning on
+  detectably-noisy hosts (rep spread > 1.6×).
+
+``python benchmarks/serving_bench.py [--out PATH] [--docs N]`` — one JSON line.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import re
+import socket
+import statistics
+import sys
+import threading
+import time
+import urllib.request
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+DOC_WORDS = 12  # uniform length -> one sequence bucket, composition-independent
+N_DOCS = 256
+PRESET = "minilm"  # the Adaptive-RAG default embedder — the honest regime
+K = 1
+COALESCE_MS = 10  # the serving coalesce window every leg runs under
+
+LAT_WARM = 6
+LAT_REQS = 32
+#: the latency legs' poll interval: an ingest-tuned autocommit (big ticks for
+#: backfill efficiency). Pre-r14, serving latency was FLOORED by it; the
+#: arrival-driven path must be independent of it — that is the headline.
+POLL_AUTOCOMMIT_MS = 200
+#: tick cadence for the throughput/flood legs (arrival wakeups dominate it)
+TPUT_AUTOCOMMIT_MS = 50
+
+TPUT_CLIENTS = 32
+TPUT_REQS_PER_CLIENT = 6
+TPUT_REPS = 3
+
+FLOOD_CLIENTS = 8
+FLOOD_REQS_PER_CLIENT = 32
+FLOOD_CLIENT_PAUSE_S = 0.03
+FLOOD_MULTIPLE = 10  # bulk doc rows per interactive query row
+SLO_MULTIPLE = 3.0  # r9 burst-test discipline: flooded p99 <= 3x unloaded
+
+GATE_LATENCY_X = 2.0
+GATE_TPUT_PCT = 80.0
+GATE_DROP_PCT = 25.0
+
+
+def synth_docs(n: int) -> list[str]:
+    rng = np.random.default_rng(7)
+    vocab = [f"word{i}" for i in range(2000)]
+    return [" ".join(rng.choice(vocab, size=DOC_WORDS)) for _ in range(n)]
+
+
+def _free_port() -> int:
+    s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _wait_ready(port: int, timeout: float = 30.0) -> None:
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        try:
+            socket.create_connection(("127.0.0.1", port), timeout=0.5).close()
+            return
+        except OSError:
+            time.sleep(0.02)
+    raise RuntimeError(f"serving port {port} never came up")
+
+
+def _post(port: int, query: str) -> dict:
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/",
+        data=json.dumps({"query": query}).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    return json.loads(urllib.request.urlopen(req, timeout=60).read())
+
+
+def _pctile(xs: list[float], q: float) -> float:
+    ys = sorted(xs)
+    return ys[min(len(ys) - 1, int(q * len(ys)))]
+
+
+_EMB = None
+_RERANKER = None
+
+
+def _models():
+    """One embedder/reranker pair for every leg: jit caches stay warm, and the
+    weights are deterministic so reuse cannot change any answer."""
+    global _EMB, _RERANKER
+    if _EMB is None:
+        from pathway_tpu.xpacks.llm.embedders import SentenceTransformerEmbedder
+        from pathway_tpu.xpacks.llm.rerankers import EncoderReranker
+
+        # serving-tier config: the bounded embedding memo means corpus docs
+        # are encoded once (index build) and never re-encoded by the rerank
+        # stage, and microbatch pad replicas collapse — hit ratio reported
+        _EMB = SentenceTransformerEmbedder(PRESET, seed=0, memoize=65536)
+        _RERANKER = EncoderReranker(_EMB)
+    return _EMB, _RERANKER
+
+
+def serve_session(
+    docs: list[str],
+    client_fn,
+    *,
+    tick_mode: str,
+    autocommit_ms: int,
+    flow: bool = False,
+    flood_rows_per_s: float | None = None,
+):
+    """Build the REST-fronted embed→KNN→rerank loop, run it, drive it with
+    ``client_fn(port)`` on a thread, return (client result, serve route snapshot,
+    flood rows ingested)."""
+    import pathway_tpu as pw
+    from pathway_tpu.internals.parse_graph import G
+    from pathway_tpu.io.http._server import serving_status
+    from pathway_tpu.stdlib.indexing import BruteForceKnnFactory
+
+    os.environ["PATHWAY_SERVE_TICK"] = tick_mode
+    os.environ["PATHWAY_SERVE_COALESCE_MS"] = str(COALESCE_MS)
+    os.environ["PATHWAY_FLOW"] = "on" if flow else "off"
+    # serving configuration: the arrival-driven tick IS the batch (concurrent
+    # requests coalesce before the engine sees them), so cross-tick microbatch
+    # stages flush on every frontier round — holding rows toward the
+    # autocommit deadline would add one poll interval PER STAGE of the
+    # embed→KNN→rerank chain (a lone query would resolve a full tick late)
+    os.environ["PATHWAY_MICROBATCH_FLUSH_MS"] = "0"
+    # bulk rows here cost a device embed each: the full-pressure bulk floor
+    # must stay a fraction of a tick's device budget or every tick under
+    # flood stalls the interactive chain behind one oversized bulk launch;
+    # the queue bound likewise caps the worst-case single-tick drain (the
+    # window before the pressure signal engages the admission budgets)
+    os.environ["PATHWAY_FLOW_BULK_MIN_ROWS"] = "8"
+    os.environ["PATHWAY_FLOW_BULK_MAX_ROWS"] = "32"
+    os.environ["PATHWAY_INPUT_QUEUE_ROWS"] = "2048"
+    G.clear()
+    emb, rr = _models()
+    port = _free_port()
+
+    doc_t = pw.debug.table_from_rows(
+        pw.schema_from_types(text=str), [(d,) for d in docs]
+    )
+    flood_state = {"rows": 0}
+    if flood_rows_per_s:
+        from pathway_tpu.io.python import ConnectorSubject
+
+        class _FloodSubject(ConnectorSubject):
+            def __init__(self) -> None:
+                super().__init__()
+                self._stop = False
+                self._i = 0
+
+            def run(self) -> None:
+                batch = 32
+                pause = batch / flood_rows_per_s
+                vocab = [f"flood{i}" for i in range(512)]
+                rng = np.random.default_rng(11)
+                while not self._stop:
+                    rows = []
+                    for _ in range(batch):
+                        self._i += 1
+                        rows.append(
+                            {"text": " ".join(rng.choice(vocab, size=DOC_WORDS))}
+                        )
+                    self.next_batch(rows)
+                    flood_state["rows"] += batch
+                    time.sleep(pause)
+
+            def on_stop(self) -> None:
+                self._stop = True
+
+        flood_t = pw.io.python.read(
+            _FloodSubject(),
+            schema=pw.schema_from_types(text=str),
+            service_class="bulk",
+            name="flood_docs",
+        )
+        doc_t = doc_t.concat_reindex(flood_t)
+
+    # reserved_space sizes the brute-force device matrix (and so the search
+    # kernel's compiled shape): corpus-sized for the fixed legs, headroom for
+    # the flood leg's live ingest
+    reserve = len(docs) + (8192 if flood_rows_per_s else 0)
+    index = BruteForceKnnFactory(
+        embedder=emb, reserved_space=reserve
+    ).build_index(doc_t.text, doc_t)
+
+    queries, respond = pw.io.http.rest_connector(
+        host="127.0.0.1",
+        port=port,
+        schema=pw.schema_from_types(query=str),
+    )
+    picked = index.query_as_of_now(queries.query, number_of_matches=K).select(
+        q=pw.left.query,
+        top=pw.apply(lambda ts: ts[0] if ts else "", pw.right.text),
+    )
+    # rerank as a top-level column so the batched UDF rides the microbatch
+    # dispatch path (nested inside pw.apply it would run row-wise)
+    scored = picked.select(picked.top, score=rr(picked.top, picked.q))
+    reply = scored.select(
+        result=pw.apply(
+            lambda t, s: {"top": t, "score": round(float(s), 6)},
+            scored.top,
+            scored.score,
+        )
+    )
+    respond(reply)
+
+    out: dict = {}
+
+    def orchestrate() -> None:
+        _wait_ready(port)
+        try:
+            out["result"] = client_fn(port)
+        finally:
+            rt = pw.internals.run.current_runtime()
+            if rt is not None:
+                rt.request_stop()
+
+    th = threading.Thread(target=orchestrate)
+    th.start()
+    pw.run(monitoring_level="none", autocommit_duration_ms=autocommit_ms)
+    th.join()
+    serving = serving_status(pw.internals.run.current_runtime())
+    route = serving["routes"][0] if serving else {}
+    return out.get("result"), route, flood_state["rows"]
+
+
+# ------------------------------------------------------------------ leg 1: p50
+
+
+def latency_leg(docs: list[str], queries: list[str]) -> dict:
+    def client(port: int):
+        for q in queries[:LAT_WARM]:
+            _post(port, q)
+        timings, answers = [], {}
+        for q in queries:
+            t0 = time.perf_counter()
+            answers[q] = _post(port, q)
+            timings.append(time.perf_counter() - t0)
+        return timings, answers
+
+    res = {}
+    answers = {}
+    for mode in ("poll", "arrival"):
+        (timings, ans), _route, _fl = serve_session(
+            docs, client, tick_mode=mode, autocommit_ms=POLL_AUTOCOMMIT_MS
+        )
+        res[mode] = {
+            "p50_ms": round(_pctile(timings, 0.5) * 1e3, 2),
+            "p99_ms": round(_pctile(timings, 0.99) * 1e3, 2),
+            "mean_ms": round(statistics.mean(timings) * 1e3, 2),
+        }
+        answers[mode] = ans
+    res["speedup_p50_x"] = round(
+        res["poll"]["p50_ms"] / max(res["arrival"]["p50_ms"], 1e-6), 2
+    )
+    res["byte_identical"] = answers["poll"] == answers["arrival"]
+    return res
+
+
+# ----------------------------------------------------------- leg 2: throughput
+
+
+def _concurrent_client(queries_per_client: list[list[str]], warm_per_client: int = 2):
+    """Closed-loop concurrent clients. Each client first sends
+    ``warm_per_client`` untimed requests THROUGH the serving path (one full
+    concurrency wave), so the padded-bucket XLA compiles the concurrent
+    shapes trigger land outside the clock — the same discipline every other
+    bench applies to direct device calls."""
+
+    def client(port: int):
+        n_clients = len(queries_per_client)
+        barrier = threading.Barrier(n_clients + 1)
+        answers: list[dict] = [None] * n_clients  # type: ignore[list-item]
+
+        def one(ci: int) -> None:
+            for w in range(warm_per_client):
+                _post(port, f"warm client{ci} wave{w}")
+            barrier.wait()
+            got = {}
+            for q in queries_per_client[ci]:
+                got[q] = _post(port, q)
+            answers[ci] = got
+
+        threads = [
+            threading.Thread(target=one, args=(ci,)) for ci in range(n_clients)
+        ]
+        for t in threads:
+            t.start()
+        barrier.wait()
+        t0 = time.perf_counter()
+        for t in threads:
+            t.join()
+        wall = time.perf_counter() - t0
+        merged: dict = {}
+        for a in answers:
+            merged.update(a)
+        return wall, merged
+
+    return client
+
+
+def direct_ceiling(docs: list[str], queries: list[str], batch: int, reps: int) -> float:
+    """The same serving work — encode queries, exact top-1 search, rerank
+    (re-encode doc + query, dot) — driven directly in device batches with no
+    HTTP or engine in the path. Queries/s, best of ``reps``."""
+    emb, _rr = _models()
+    from pathway_tpu.ops.knn import BruteForceKnnIndex
+
+    enc = emb._encoder
+    corpus = np.stack(enc.encode_texts(docs))
+    index = BruteForceKnnIndex(
+        dimension=corpus.shape[1], metric="cos", capacity=len(docs)
+    )
+    index.add_batch(list(range(len(docs))), corpus)
+    index._flush()
+
+    def run_once() -> float:
+        t0 = time.perf_counter()
+        for i in range(0, len(queries), batch):
+            chunk = queries[i : i + batch]
+            qv = np.stack(enc.encode_texts(chunk))
+            hits = index.search(qv, K)
+            top = [docs[h[0][0]] if h else "" for h in hits]
+            dv = np.stack(enc.encode_texts(top))
+            qv2 = np.stack(enc.encode_texts(chunk))
+            _scores = np.sum(dv * qv2, axis=1)
+        return len(queries) / (time.perf_counter() - t0)
+
+    run_once()  # warm/compile
+    return max(run_once() for _ in range(reps))
+
+
+def throughput_leg(docs: list[str], rng: np.random.Generator) -> dict:
+    total = TPUT_CLIENTS * TPUT_REQS_PER_CLIENT
+
+    def fresh_queries(tag: str) -> list[list[str]]:
+        """Every rep serves NEVER-SEEN query strings (real query traffic does
+        not repeat; only corpus-doc embeddings may be memo-warm)."""
+        qs = [
+            f"{docs[int(i)]} {tag}q{j}"
+            for j, i in enumerate(rng.integers(0, len(docs), total))
+        ]
+        return [
+            qs[ci * TPUT_REQS_PER_CLIENT : (ci + 1) * TPUT_REQS_PER_CLIENT]
+            for ci in range(TPUT_CLIENTS)
+        ]
+
+    emb, _rr = _models()
+    runs: list[float] = []
+    direct_runs: list[float] = []
+    route_snap: dict = {}
+    # interleaved (r11 protocol): each rep measures the serving path AND the
+    # direct ceiling back-to-back so host drift lands on both equally
+    for rep in range(TPUT_REPS):
+        per_client = fresh_queries(f"r{rep}")
+        (wall, _answers), route, _fl = serve_session(
+            docs,
+            _concurrent_client(per_client),
+            tick_mode="arrival",
+            autocommit_ms=TPUT_AUTOCOMMIT_MS,
+        )
+        runs.append(total / wall)
+        route_snap = route
+        flat = [q for c in per_client for q in c]
+        direct_runs.append(direct_ceiling(docs, flat, TPUT_CLIENTS, 1))
+    # byte-identity across paths: the SAME query set through poll and arrival
+    ident_queries = fresh_queries("ident")
+    (wall_a, answers_arrival), _r, _fl = serve_session(
+        docs,
+        _concurrent_client(ident_queries),
+        tick_mode="arrival",
+        autocommit_ms=TPUT_AUTOCOMMIT_MS,
+    )
+    (wall_p, answers_poll), _r2, _fl = serve_session(
+        docs,
+        _concurrent_client(ident_queries, warm_per_client=0),
+        tick_mode="poll",
+        autocommit_ms=TPUT_AUTOCOMMIT_MS,
+    )
+    spread = max(runs) / max(min(runs), 1e-9)
+    qps = max(runs)
+    direct_qps = max(direct_runs)
+    hits, misses = emb.memo_hits, emb.memo_misses
+    return {
+        "serving_qps": round(qps, 1),
+        # the poll pass reuses the arrival pass's query set for byte-identity,
+        # so its embeds are memo-warm — comparable only with that caveat
+        "poll_qps_memo_warm": round(total / wall_p, 1),
+        "direct_qps": round(direct_qps, 1),
+        "pct_of_direct": round(100.0 * qps / direct_qps, 1),
+        "clients": TPUT_CLIENTS,
+        "requests": total,
+        "mean_coalesced_batch": route_snap.get("mean_batch"),
+        "embed_memo_hit_ratio": round(hits / max(1, hits + misses), 3),
+        "rep_spread": round(spread, 2),
+        "byte_identical": answers_arrival == answers_poll,
+    }
+
+
+# ---------------------------------------------------------------- leg 3: flood
+
+
+def flood_leg(docs: list[str], rng: np.random.Generator) -> dict:
+    total = FLOOD_CLIENTS * FLOOD_REQS_PER_CLIENT
+    qs = [f"{docs[int(i)]} f{j}" for j, i in enumerate(rng.integers(0, len(docs), total))]
+    per_client = [
+        qs[ci * FLOOD_REQS_PER_CLIENT : (ci + 1) * FLOOD_REQS_PER_CLIENT]
+        for ci in range(FLOOD_CLIENTS)
+    ]
+
+    def client(port: int):
+        n = len(per_client)
+        barrier = threading.Barrier(n + 1)
+        lat: list[list[float]] = [None] * n  # type: ignore[list-item]
+
+        def one(ci: int) -> None:
+            _post(port, f"warm flood client{ci}")  # compiles outside the clock
+            barrier.wait()
+            mine = []
+            for q in per_client[ci]:
+                t0 = time.perf_counter()
+                _post(port, q)
+                mine.append(time.perf_counter() - t0)
+                time.sleep(FLOOD_CLIENT_PAUSE_S)
+            lat[ci] = mine
+
+        threads = [threading.Thread(target=one, args=(ci,)) for ci in range(n)]
+        for t in threads:
+            t.start()
+        barrier.wait()
+        t0 = time.perf_counter()
+        for t in threads:
+            t.join()
+        wall = time.perf_counter() - t0
+        return wall, [x for xs in lat for x in xs]
+
+    # unloaded first: it also measures the interactive row rate the flood
+    # multiplies
+    (wall_u, lat_u), _route, _fl = serve_session(
+        docs, client, tick_mode="arrival", autocommit_ms=TPUT_AUTOCOMMIT_MS, flow=True
+    )
+    query_rate = total / wall_u
+    flood_rate = FLOOD_MULTIPLE * query_rate
+    (wall_f, lat_f), route_f, flood_rows = serve_session(
+        docs,
+        client,
+        tick_mode="arrival",
+        autocommit_ms=TPUT_AUTOCOMMIT_MS,
+        flow=True,
+        flood_rows_per_s=flood_rate,
+    )
+    p99_u = _pctile(lat_u, 0.99)
+    p99_f = _pctile(lat_f, 0.99)
+    return {
+        "unloaded_p99_ms": round(p99_u * 1e3, 2),
+        "flooded_p99_ms": round(p99_f * 1e3, 2),
+        "p99_ratio": round(p99_f / max(p99_u, 1e-9), 2),
+        "slo_multiple": SLO_MULTIPLE,
+        "interactive_qps_unloaded": round(query_rate, 1),
+        "flood_rows_per_s_target": round(flood_rate, 1),
+        "flood_rows_ingested": flood_rows,
+        "flooded_responses": route_f.get("responses_total"),
+        "within_slo": bool(p99_f <= SLO_MULTIPLE * p99_u),
+    }
+
+
+# ------------------------------------------------------------- regression gate
+
+
+def _last_committed_qps(exclude: str | None = None):
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    best = None
+    for path in glob.glob(os.path.join(root, "BENCH_r*.json")):
+        m = re.search(r"BENCH_r(\d+)\.json$", path)
+        if not m:
+            continue
+        if exclude and os.path.abspath(path) == os.path.abspath(exclude):
+            continue
+        try:
+            blob = json.loads(open(path).read())
+        except (OSError, ValueError):
+            continue
+        if not isinstance(blob, dict):
+            continue
+        qps = blob.get("serving", {}).get("throughput", {}).get("serving_qps")
+        if qps is None:
+            continue
+        rev = int(m.group(1))
+        if best is None or rev > best[0]:
+            best = (rev, float(qps), os.path.basename(path))
+    if best is None:
+        return None
+    return best[1], best[2]
+
+
+def full(n_docs: int = N_DOCS, out_path: str | None = None) -> dict:
+    prev_env = {
+        k: os.environ.get(k)
+        for k in (
+            "PATHWAY_SERVE_TICK",
+            "PATHWAY_SERVE_COALESCE_MS",
+            "PATHWAY_FLOW",
+            "PATHWAY_MICROBATCH",
+            "PATHWAY_MICROBATCH_FLUSH_MS",
+            "PATHWAY_FLOW_BULK_MIN_ROWS",
+            "PATHWAY_FLOW_BULK_MAX_ROWS",
+            "PATHWAY_INPUT_QUEUE_ROWS",
+        )
+    }
+    try:
+        docs = synth_docs(n_docs)
+        rng = np.random.default_rng(23)
+        emb, _ = _models()
+        # compile outside every clock: the engine pads launches to power-of-2
+        # buckets, so pre-encode each bucket size the legs can produce
+        for b in (8, 16, 32, 64, 128, 256, 512):
+            emb._encoder.encode_texts((docs * 2)[:b])
+
+        lat = latency_leg(docs, [f"{docs[i % len(docs)]} l{i}" for i in range(LAT_REQS)])
+        tput = throughput_leg(docs, rng)
+        flood = flood_leg(docs, rng)
+
+        results: dict = {
+            "bench": "serving",
+            "n_docs": n_docs,
+            "preset": PRESET,
+            "poll_autocommit_ms": POLL_AUTOCOMMIT_MS,
+            "serving": {"latency": lat, "throughput": tput, "flood": flood},
+            # top-level copies for the regression gate + BASELINE tables
+            "serving_qps": tput["serving_qps"],
+            "serving_latency_speedup_x": lat["speedup_p50_x"],
+        }
+        spread = tput["rep_spread"]
+        noisy = spread > 1.6
+        results["rep_spread_max"] = spread
+        results["noisy_host"] = noisy
+
+        gate_ok = True
+        failures = []
+        if lat["speedup_p50_x"] < GATE_LATENCY_X:
+            gate_ok = False
+            failures.append(
+                f"arrival p50 speedup {lat['speedup_p50_x']}x < required {GATE_LATENCY_X}x"
+            )
+        if not lat["byte_identical"]:
+            gate_ok = False
+            failures.append("poll vs arrival responses not byte-identical (latency leg)")
+        if not tput["byte_identical"]:
+            gate_ok = False
+            failures.append("poll vs arrival responses not byte-identical (throughput leg)")
+        if tput["pct_of_direct"] < GATE_TPUT_PCT:
+            gate_ok = False
+            failures.append(
+                f"coalesced serving at {tput['pct_of_direct']}% of direct-encode "
+                f"ceiling < required {GATE_TPUT_PCT}%"
+            )
+        if not flood["within_slo"]:
+            gate_ok = False
+            failures.append(
+                f"flooded interactive p99 {flood['flooded_p99_ms']}ms > "
+                f"{SLO_MULTIPLE}x unloaded {flood['unloaded_p99_ms']}ms"
+            )
+        prev = _last_committed_qps(exclude=out_path)
+        if prev is not None:
+            prev_qps, prev_file = prev
+            results["gate_baseline_qps"] = prev_qps
+            results["gate_baseline_file"] = prev_file
+            if tput["serving_qps"] < prev_qps * (1 - GATE_DROP_PCT / 100):
+                msg = (
+                    f"serving qps regressed: {tput['serving_qps']} vs {prev_qps} "
+                    f"in {prev_file} (allowed drop {GATE_DROP_PCT}%)"
+                )
+                if noisy:
+                    print(
+                        f"WARNING (noisy host, gate downgraded): {msg}",
+                        file=sys.stderr,
+                    )
+                else:
+                    gate_ok = False
+                    failures.append(msg)
+        results["gate_ok"] = gate_ok
+        if not gate_ok:
+            print(json.dumps(results))
+            for f in failures:
+                print(f"GATE FAILURE: {f}", file=sys.stderr)
+            if os.environ.get("BENCH_MODE") == "1":
+                sys.exit(1)
+            print(
+                "WARNING: gate failures above (hard-fail under BENCH_MODE=1)",
+                file=sys.stderr,
+            )
+        return results
+    finally:
+        for k, v in prev_env.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+
+if __name__ == "__main__":
+    args = sys.argv[1:]
+    out_path = None
+    n = N_DOCS
+    if "--out" in args:
+        i = args.index("--out")
+        out_path = args[i + 1]
+        del args[i : i + 2]
+    if "--docs" in args:
+        i = args.index("--docs")
+        n = int(args[i + 1])
+        del args[i : i + 2]
+    res = full(n, out_path=out_path)
+    line = json.dumps(res)
+    print(line)
+    if out_path:
+        with open(out_path, "w") as f:
+            f.write(line + "\n")

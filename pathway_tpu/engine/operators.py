@@ -13,6 +13,7 @@ from __future__ import annotations
 
 import threading
 import time as _time_mod
+from collections import OrderedDict
 from typing import Any, Callable, Iterable
 
 import numpy as np
@@ -669,6 +670,9 @@ class MicrobatchApplyNode(Node):
 
     snapshot_attrs = ("waiting", "emitted")
 
+    #: replay-cache FIFO bound — sized past any in-flight serving window
+    _RECENT_MAX = 8192
+
     def exchange_key(self, port):
         # device UDF rows spread across workers by key shard, same as an
         # expensive RowwiseNode — each worker accumulates and launches its shard
@@ -716,6 +720,16 @@ class MicrobatchApplyNode(Node):
         # selects keep no state and recompute retract rows like the inline path.
         self._remember = any(not s.deterministic for s in udf_specs)
         self.emitted: dict[int, list] = {}
+        # bounded replay cache for all-DETERMINISTIC selects: key ->
+        # (input signature, output row) of recent emissions. A retract of a
+        # recently-emitted row replays the cached output instead of re-running
+        # the device UDF — value-identical by the determinism contract, and
+        # load-bearing for the serving plane, where every served query row is
+        # retracted one tick after its response (delete_completed_queries):
+        # without it each retract re-embeds its row in a tiny padded launch.
+        # Pure cache: a miss falls back to recompute, so the FIFO bound and
+        # its absence from snapshots cost correctness nothing.
+        self._recent: "OrderedDict[int, tuple]" = OrderedDict()
 
     def restore_state(self, state: dict) -> None:
         super().restore_state(state)
@@ -875,9 +889,15 @@ class MicrobatchApplyNode(Node):
         out_diffs: list[int] = []
         out_rows: list[tuple] = []
         unknown: list[tuple[int, int]] = []  # (row index, residual diff)
-        # input signatures of every retract row whose key is buffered — one
-        # vectorized _entry_rows pass, not a 1-row program per retract
-        cand = [int(i) for i in idx if int(batch.keys[i]) in self.waiting]
+        # input signatures of every retract row whose key is buffered (or in
+        # the recent-emission replay cache) — one vectorized _entry_rows pass,
+        # not a 1-row program per retract
+        cand = [
+            int(i)
+            for i in idx
+            if int(batch.keys[i]) in self.waiting
+            or int(batch.keys[i]) in self._recent
+        ]
         sigs: dict[int, tuple] = {}
         if cand:
             _k, _d, pts, cls = self._entry_rows(
@@ -920,6 +940,14 @@ class MicrobatchApplyNode(Node):
                 e[0] += d
                 if e[0] <= 0:
                     del self.emitted[k]
+                continue
+            rec = self._recent.get(k)
+            if rec is not None and self._sig_matches(sigs[i], rec[0]):
+                # deterministic replay: the cached emission IS what a
+                # recompute would produce for these inputs — skip the launch
+                out_keys.append(k)
+                out_diffs.append(d)
+                out_rows.append(rec[1])
                 continue
             unknown.append((i, d))
         if unknown:
@@ -1012,6 +1040,11 @@ class MicrobatchApplyNode(Node):
                 else:
                     e[0] += diff
                     e[1] = row
+            else:
+                rec = self._recent
+                rec[k] = (self._entry_sig(entry[2], entry[3]), row)
+                if len(rec) > self._RECENT_MAX:
+                    rec.popitem(last=False)
         return [
             DeltaBatch.from_rows(
                 out_keys, out_rows, self.out_columns, time,

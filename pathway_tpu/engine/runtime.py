@@ -9,11 +9,68 @@ drain the dataflow — until every input is exhausted, then flush and close.
 
 from __future__ import annotations
 
+import threading
 import time as _time
 from typing import Any, Protocol
 
 from pathway_tpu.engine.graph import Scheduler
 from pathway_tpu.internals.logical import LogicalNode, build_engine_graph
+
+
+class TickWakeup:
+    """Arrival-driven tick scheduling (the serving plane's latency lever).
+
+    The streaming loops sleep the remainder of the autocommit period between
+    ticks, so before r14 a REST query arriving right after a tick waited the
+    whole poll interval before the engine even saw it. Connectors call
+    :meth:`request` when work arrives: ``delay_s=0`` wakes the loop NOW (a
+    full coalesce bucket is waiting), a positive delay bounds how long the
+    arrival may coalesce with concurrent requests
+    (``PATHWAY_SERVE_COALESCE_MS``) before a tick is forced. The loop's
+    :meth:`wait` replaces its fixed sleep — an un-requested wait degrades to
+    exactly the old autocommit sleep, so non-serving pipelines are unchanged.
+    """
+
+    __slots__ = ("_cond", "_immediate", "_deadline")
+
+    def __init__(self) -> None:
+        self._cond = threading.Condition()
+        self._immediate = False
+        #: perf_counter deadline of the earliest delayed request, or None
+        self._deadline: float | None = None
+
+    def request(self, delay_s: float = 0.0) -> None:
+        """Schedule a tick at most ``delay_s`` seconds from now (0 = now).
+        Called from connector/handler threads; never blocks. A delayed
+        request landing while the loop is already asleep re-arms the sleep
+        with the shorter target (the condition variable wakes it to
+        recompute), so the coalesce bound holds regardless of arrival phase."""
+        with self._cond:
+            if delay_s <= 0.0:
+                self._immediate = True
+            else:
+                deadline = _time.perf_counter() + delay_s
+                if self._deadline is not None and deadline >= self._deadline:
+                    return  # an earlier wakeup is already armed
+                self._deadline = deadline
+            self._cond.notify_all()
+
+    def wait(self, timeout: float) -> None:
+        """Sleep until ``timeout`` elapses, a pending coalesce deadline
+        passes, or an immediate tick is requested — whichever is first. Both
+        request states are consumed on return: the tick that follows this
+        wait drains every queue, satisfying all requests made before it."""
+        end = _time.perf_counter() + timeout
+        with self._cond:
+            while not self._immediate:
+                now = _time.perf_counter()
+                target = end if self._deadline is None else min(end, self._deadline)
+                remaining = target - now
+                if remaining <= 0:
+                    break
+                self._cond.wait(remaining)
+            self._immediate = False
+            self._deadline = None
 
 
 class ConnectorDriver(Protocol):
@@ -52,6 +109,9 @@ class Runtime:
         self.scheduler: Scheduler | None = None
         self.persistence: Any = None  # set by pathway_tpu.persistence.attach
         self._stop_requested = False
+        # arrival-driven tick scheduling: connectors (the REST serving plane)
+        # request a wakeup instead of waiting out the autocommit poll
+        self.wakeup = TickWakeup()
         #: set once the graph is built: live-connector runs tick repeatedly, so
         #: cross-tick accumulators (microbatch UDF buffers) may hold rows until
         #: their autocommit deadline; static runs have exactly one tick and
@@ -146,7 +206,7 @@ class Runtime:
                 if not all_virtual:
                     elapsed = _time.perf_counter() - t0
                     if elapsed < period:
-                        _time.sleep(period - elapsed)
+                        self.wakeup.wait(period - elapsed)
         finally:
             for driver in self.connectors:
                 driver.stop()

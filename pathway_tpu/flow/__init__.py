@@ -53,7 +53,10 @@ class FlowPlane:
         self.controller = AimdController(
             slo_ms=cfg.latency_slo_ms, max_bucket=cfg.microbatch_max_batch
         )
-        self.admission = AdmissionScheduler(bulk_min_rows=cfg.flow_bulk_min_rows)
+        self.admission = AdmissionScheduler(
+            bulk_min_rows=cfg.flow_bulk_min_rows,
+            bulk_max_rows=cfg.flow_bulk_max_rows,
+        )
         self._lock = threading.Lock()
         self.gates: list[IngestGate] = []
         self.cluster_pressure = 0.0  # last merged pod-wide pressure seen

@@ -48,22 +48,36 @@ def validate_service_class(service_class: str) -> str:
 class AdmissionScheduler:
     """Writes per-tick admission budgets onto the gates."""
 
-    def __init__(self, bulk_min_rows: int):
+    def __init__(self, bulk_min_rows: int, bulk_max_rows: int = 0):
         self.bulk_min_rows = max(1, int(bulk_min_rows))
+        #: standing per-tick bulk drain ceiling (0 = none, the r9 behavior).
+        #: The pressure signal is REACTIVE — it engages only after interactive
+        #: latency has already degraded — so when bulk rows carry real device
+        #: cost (doc-ingest embeds in a serving tier), a flood's first ticks
+        #: drain unbudgeted and stall the query path before the controller
+        #: can respond. The ceiling bounds that window unconditionally.
+        self.bulk_max_rows = max(0, int(bulk_max_rows))
 
     def plan(self, gates: list[Any], pressure: float) -> None:
         """Set each gate's budget for the NEXT tick from the current pressure
         in [0, 1]. Interactive gates are never budgeted."""
+        cap = self.bulk_max_rows or None
+        if cap is not None:
+            # the ceiling never undercuts the starvation floor: bulk_min_rows
+            # is the under-pressure progress GUARANTEE, a lower cap would
+            # silently void it
+            cap = max(cap, self.bulk_min_rows)
         for gate in gates:
             if getattr(gate.node, "service_class", INTERACTIVE) != BULK:
                 gate.budget = None
                 continue
             if pressure <= _PRESSURE_FLOOR:
-                gate.budget = None
+                gate.budget = cap
                 continue
             # linear back-off from a full queue's worth of admission down to
             # the guaranteed minimum at pressure >= 1
             frac = max(0.0, 1.0 - min(1.0, pressure))
-            gate.budget = max(
+            budget = max(
                 self.bulk_min_rows, int(gate.effective_bound() * frac)
             )
+            gate.budget = min(budget, cap) if cap is not None else budget

@@ -118,6 +118,19 @@ class IngestGate:
             self.admitted_rows += n
             return n
 
+    def try_admit(self, n: int = 1) -> bool:
+        """Non-blocking admission for latency-bound callers (the REST front
+        door's event loop must neither wait for credit nor have a push shed
+        silently after registering a response future): take ``n`` credits if
+        available RIGHT NOW, else refuse — the caller sheds with an explicit
+        429. A refused caller has consumed nothing."""
+        with self._cond:
+            if self.closed or self.available() < n:
+                return False
+            self.queued += n
+            self.admitted_rows += n
+            return True
+
     def admit_retract(self) -> int:
         """Admit a retraction without ever DROPPING it: the matching insert is
         already in downstream state, so a shed retract would leave a phantom

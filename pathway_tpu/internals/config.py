@@ -274,6 +274,60 @@ class PathwayConfig:
         return max(1, _env_int("PATHWAY_FLOW_BULK_MIN_ROWS", 64))
 
     @property
+    def flow_bulk_max_rows(self) -> int:
+        """Standing per-tick bulk drain ceiling, applied even at zero
+        pressure (0 = unlimited, the r9 behavior). The pressure signal is
+        reactive — it engages only after interactive latency degrades — so
+        serving tiers whose bulk rows carry real device cost (doc-ingest
+        embeds) set this to bound the stall a fresh flood can inflict before
+        the controller responds."""
+        return max(0, _env_int("PATHWAY_FLOW_BULK_MAX_ROWS", 0))
+
+    # ---- REST serving plane (io/http rest_connector) ------------------------
+    @property
+    def serve_max_inflight(self) -> int:
+        """Bounded in-flight request budget per REST route: requests admitted
+        but not yet answered. Past it the route sheds with a fast 429 +
+        ``Retry-After`` instead of growing an unbounded futures dict — the
+        serving-side mirror of the ingest credit gate."""
+        n = _env_int("PATHWAY_SERVE_MAX_INFLIGHT", 1024)
+        if n < 1:
+            raise ValueError(f"PATHWAY_SERVE_MAX_INFLIGHT must be >= 1, got {n}")
+        return n
+
+    @property
+    def serve_coalesce_ms(self) -> float:
+        """How long a query arrival may wait for concurrent requests to
+        coalesce into the same engine tick before a tick is forced. The
+        arrival-driven scheduler wakes the tick loop after this delay (or
+        immediately once ``PATHWAY_SERVE_COALESCE_ROWS`` requests are
+        waiting), so single-request latency is ~this bound plus the tick,
+        instead of the autocommit poll interval."""
+        v = _env_float("PATHWAY_SERVE_COALESCE_MS", 2.0)
+        if v < 0:
+            raise ValueError(f"PATHWAY_SERVE_COALESCE_MS must be >= 0, got {v}")
+        return v
+
+    @property
+    def serve_coalesce_rows(self) -> int:
+        """In-flight request count that triggers an IMMEDIATE tick wakeup —
+        a full coalesce bucket shouldn't wait out the coalesce window."""
+        return max(1, _env_int("PATHWAY_SERVE_COALESCE_ROWS", 64))
+
+    @property
+    def serve_tick(self) -> str:
+        """REST query tick scheduling: ``arrival`` (default — query arrival
+        wakes the tick loop through the coalesce window above) or ``poll``
+        (pre-r14 behavior: requests wait for the fixed autocommit poll; the
+        serving bench's baseline mode)."""
+        raw = os.environ.get("PATHWAY_SERVE_TICK", "arrival").strip().lower()
+        if raw not in ("arrival", "poll"):
+            raise ValueError(
+                f"PATHWAY_SERVE_TICK must be arrival/poll, got {raw!r}"
+            )
+        return raw
+
+    @property
     def monitoring_server(self) -> str | None:
         return os.environ.get("PATHWAY_MONITORING_SERVER")
 
@@ -519,8 +573,13 @@ class PathwayConfig:
                 "flow",
                 "flow_policy",
                 "flow_bulk_min_rows",
+                "flow_bulk_max_rows",
                 "input_queue_rows",
                 "latency_slo_ms",
+                "serve_max_inflight",
+                "serve_coalesce_ms",
+                "serve_coalesce_rows",
+                "serve_tick",
                 "monitoring_server",
                 "profile",
                 "index_snapshot",

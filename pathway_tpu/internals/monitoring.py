@@ -139,6 +139,14 @@ def run_stats(runtime) -> dict[str, Any]:
     ts = _obs.device.index_tier_stats()
     if ts is not None:
         stats["index"] = ts
+    # REST serving plane: per-route request/response/shed counters, in-flight
+    # occupancy vs budget, coalesced batch sizes and arrival-to-response
+    # latency quantiles (present only while rest_connector routes are live)
+    from pathway_tpu.io.http import _server as _rest_serve
+
+    serving = _rest_serve.serving_status(runtime)
+    if serving is not None:
+        stats["serving"] = serving
     # live error log: per-operator row-level failure counts (UDF raises under
     # terminate_on_error=False — previously only visible via pw.global_error_log())
     from pathway_tpu.internals import error_log as _error_log
@@ -287,6 +295,10 @@ def prometheus_text(runtime) -> str:
             lines.append(
                 f'pathway_sink_latency_seconds_count{{{_fmt_label(sink=label)}}} {snap["count"]}'
             )
+    # ---- REST serving plane (per-route requests/sheds/latency) --------------
+    from pathway_tpu.io.http import _server as _rest_serve
+
+    lines.extend(_rest_serve.serving_prometheus_lines(runtime))
     # ---- device profiling plane (compiles, pad waste, memory, FLOPs) --------
     lines.extend(_obs.device.prometheus_lines(runtime))
     # ---- data-plane audit (edge cardinality, violations, divergences) -------

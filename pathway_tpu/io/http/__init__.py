@@ -5,7 +5,13 @@ the request/response bridge that makes streaming RAG servers possible. Implement
 in this package in ``_server.py`` on aiohttp.
 """
 
-from pathway_tpu.io.http._server import PathwayWebserver, rest_connector, response_writer
+from pathway_tpu.io.http._server import (
+    EndpointDocumentation,
+    PathwayWebserver,
+    openapi_spec,
+    rest_connector,
+    response_writer,
+)
 
 
 def read(
@@ -86,4 +92,12 @@ def write(
     )._register_as_output()
 
 
-__all__ = ["rest_connector", "response_writer", "PathwayWebserver", "read", "write"]
+__all__ = [
+    "EndpointDocumentation",
+    "PathwayWebserver",
+    "openapi_spec",
+    "read",
+    "response_writer",
+    "rest_connector",
+    "write",
+]

@@ -1,4 +1,4 @@
-"""REST request/response connector.
+"""REST request/response connector — the production query-serving plane.
 
 Mirrors the reference's ``python/pathway/io/http/_server.py`` (``PathwayWebserver``
 aiohttp server ``:329``, ``rest_connector`` ``:624``, ``RestServerSubject`` ``:490``):
@@ -6,6 +6,30 @@ an HTTP request becomes a row in a streaming queries table (keyed by a request i
 the paired ``response_writer`` subscribes to a result table and resolves the stored
 future for that id, completing the HTTP response. Queries are append-only ("as-of-now"
 discipline) — results for a request are served once and not retracted.
+
+r14 turns the single-loop shim into a serving tier:
+
+- **Admission**: every route carries a bounded in-flight budget
+  (``PATHWAY_SERVE_MAX_INFLIGHT``) and, with the flow plane on, checks its
+  input's ``interactive``-class :class:`~pathway_tpu.flow.credit.IngestGate`
+  for credit — overload is shed with a fast ``429`` + ``Retry-After`` and an
+  exact counter instead of an unbounded futures dict.
+- **Arrival-driven query ticks**: a request no longer waits out the fixed
+  autocommit poll. Arrival schedules an engine tick through the runtime's
+  :class:`~pathway_tpu.engine.runtime.TickWakeup` after a short coalesce
+  window (``PATHWAY_SERVE_COALESCE_MS``, immediate once
+  ``PATHWAY_SERVE_COALESCE_ROWS`` requests wait), so concurrent requests
+  coalesce into ONE tick and ride the microbatch path together.
+- **Vectorized responses**: the response writer collects the tick's emissions
+  and resolves all of its futures in one pass per event loop
+  (``on_time_end``), not one ``call_soon_threadsafe`` per row.
+- **OpenAPI**: the route schemas (and the previously-ignored
+  ``documentation`` param) generate an OpenAPI 3 document served at
+  ``/_schema``.
+- **Lifecycle**: ``PathwayWebserver.stop()`` awaits the aiohttp runner's
+  cleanup and joins the server thread (back-to-back runs can reuse the
+  port); engine shutdown flushes still-pending request futures with ``503``
+  instead of leaving clients hanging for the request timeout.
 """
 
 from __future__ import annotations
@@ -13,6 +37,9 @@ from __future__ import annotations
 import asyncio
 import json as _json
 import threading
+import time as _time_mod
+import weakref
+from dataclasses import dataclass, field
 from typing import Any
 
 import numpy as np
@@ -26,6 +53,12 @@ from pathway_tpu.internals.keys import splitmix64
 from pathway_tpu.internals.logical import LogicalNode
 from pathway_tpu.internals.table import Table
 from pathway_tpu.internals.universe import Universe
+
+#: request future resolution values that are NOT payloads
+_SHUTDOWN = object()  # engine stopped with the request still pending -> 503
+
+#: client-facing request timeout (the engine answered nothing for this long)
+_REQUEST_TIMEOUT_S = 120.0
 
 
 def _jsonable(v: Any) -> Any:
@@ -42,22 +75,381 @@ def _jsonable(v: Any) -> Any:
     return v
 
 
+@dataclass
+class EndpointDocumentation:
+    """Human-facing route metadata woven into the generated OpenAPI document
+    (reference ``_server.py`` EndpointDocumentation). Every field is optional;
+    an undocumented route still appears in ``/_schema`` with its schema."""
+
+    summary: str | None = None
+    description: str | None = None
+    tags: list[str] = field(default_factory=list)
+
+
+# --------------------------------------------------------------------- serving
+
+
+class _RouteServing:
+    """Per-route serving state: the request futures, the admission budget and
+    the exact counters ``/status``'s serving section reports."""
+
+    def __init__(self, route: str, methods: tuple[str, ...], schema):
+        from pathway_tpu.observability.metrics import Histogram
+
+        self.route = route
+        self.methods = tuple(methods)
+        self.schema = schema
+        self.lock = threading.Lock()
+        self.node: ops.StreamInputNode | None = None
+        self.runtime: Any = None
+        #: key -> (future, owning event loop, arrival time_ns, row values)
+        self.futures: dict[int, tuple] = {}
+        self.seq = 0
+        self.closed = True  # open between driver.start() and flush_pending()
+        self.delete_completed = True
+        # admission knobs, re-read per run in configure()
+        self.max_inflight = 1024
+        self.coalesce_s = 0.002
+        self.coalesce_rows = 64
+        self.tick_mode = "arrival"
+        self.arrivals_since_wake = 0
+        self._wake_window_t0 = 0.0
+        # counters (exact; the shed path is only acceptable because of them)
+        self.requests_total = 0
+        self.responses_total = 0
+        self.shed_total = 0
+        self.errors_total = 0  # 4xx validation/parse failures
+        self.timeouts_total = 0
+        self.batches_total = 0  # response-resolution passes (~= serving ticks)
+        self.batched_rows_total = 0  # responses resolved by those passes
+        self.latency = Histogram()
+
+    # ---------------------------------------------------------------- lifecycle
+    def configure(self) -> None:
+        """Per-run admission/coalesce knobs (called by the connector driver's
+        ``start`` so env changes between runs take effect)."""
+        from pathway_tpu.internals.config import get_pathway_config
+
+        cfg = get_pathway_config()
+        self.max_inflight = cfg.serve_max_inflight
+        self.coalesce_s = cfg.serve_coalesce_ms / 1000.0
+        self.coalesce_rows = cfg.serve_coalesce_rows
+        self.tick_mode = cfg.serve_tick
+        self.closed = False
+
+    def flush_pending(self) -> int:
+        """Engine shutdown: resolve every still-pending request future with
+        the shutdown sentinel so handlers answer ``503`` now instead of
+        timing out after ``_REQUEST_TIMEOUT_S``. Returns how many flushed."""
+        with self.lock:
+            self.closed = True
+            pending, self.futures = self.futures, {}
+        by_loop: dict[Any, list] = {}
+        for fut, loop, _arrival_ns, _values in pending.values():
+            by_loop.setdefault(loop, []).append((fut, _SHUTDOWN))
+        for loop, items in by_loop.items():
+            try:
+                loop.call_soon_threadsafe(_set_results, items)
+            except RuntimeError:
+                pass  # loop already closed; the client connection is gone too
+        return len(pending)
+
+    # ---------------------------------------------------------------- admission
+    def try_admit(self) -> str | None:
+        """Admission check at request arrival: returns a shed reason, or None
+        when the request may proceed to parsing. The in-flight budget bounds
+        the futures dict; the flow plane's credit is taken atomically at push
+        time (:meth:`push_admitted`)."""
+        with self.lock:
+            if self.closed:
+                return "shutting_down"
+            if len(self.futures) >= self.max_inflight:
+                return "max_inflight"
+        return None
+
+    def push_admitted(self, key: int, values: tuple) -> bool:
+        """Push one admitted query row into the engine. With the flow plane
+        on, the route input's ``interactive``-class :class:`IngestGate`
+        credit is taken NON-BLOCKINGLY first — a saturated pod sheds here
+        (fast, counted, explicit 429) rather than silently dropping a row
+        whose response future is already registered, or stalling the shared
+        aiohttp event loop on the blocking credit path. The append itself
+        bypasses ``push``'s gating (the credit is already ours)."""
+        node = self.node
+        assert node is not None, "rest_connector: engine not running"
+        gate = getattr(node, "flow_gate", None)
+        if gate is not None and not gate.try_admit(1):
+            return False
+        node._append_events([(key, values, 1)])
+        return True
+
+    def schedule_tick(self) -> None:
+        """Arrival-driven tick scheduling with coalescing: the first arrival
+        arms a wakeup ``coalesce_s`` out so concurrent requests share one
+        engine tick; a full coalesce bucket wakes the loop immediately."""
+        if self.tick_mode != "arrival":
+            return
+        wakeup = getattr(self.runtime, "wakeup", None)
+        if wakeup is None:
+            return
+        now = _time_mod.monotonic()
+        with self.lock:
+            # the count is scoped to ONE coalesce window: arrivals older than
+            # the window were drained by an intervening tick, so carrying
+            # them over would eventually force every arrival to wake the
+            # loop immediately and defeat coalescing
+            if now - self._wake_window_t0 > self.coalesce_s:
+                self.arrivals_since_wake = 0
+                self._wake_window_t0 = now
+            self.arrivals_since_wake += 1
+            immediate = self.arrivals_since_wake >= self.coalesce_rows
+            if immediate:
+                self.arrivals_since_wake = 0
+                self._wake_window_t0 = now
+        wakeup.request(0.0 if immediate else self.coalesce_s)
+
+    # ---------------------------------------------------------------- telemetry
+    def snapshot(self) -> dict[str, Any]:
+        from pathway_tpu.observability.metrics import Histogram
+
+        snap = self.latency.snapshot()
+
+        def _q(q):
+            v = Histogram.quantile(snap, q)
+            return None if v is None or v == float("inf") else v
+
+        with self.lock:
+            inflight = len(self.futures)
+        return {
+            "route": self.route,
+            "methods": list(self.methods),
+            "in_flight": inflight,
+            "max_inflight": self.max_inflight,
+            "requests_total": self.requests_total,
+            "responses_total": self.responses_total,
+            "shed_total": self.shed_total,
+            "errors_total": self.errors_total,
+            "timeouts_total": self.timeouts_total,
+            "batches_total": self.batches_total,
+            "mean_batch": round(
+                self.batched_rows_total / self.batches_total, 2
+            )
+            if self.batches_total
+            else None,
+            "latency_p50_s": _q(0.5),
+            "latency_p99_s": _q(0.99),
+            "tick_mode": self.tick_mode,
+        }
+
+
+def _set_results(items: list[tuple]) -> None:
+    """One event-loop callback resolving a whole tick's futures (the
+    vectorized response pass — was one ``call_soon_threadsafe`` per row)."""
+    for fut, value in items:
+        if not fut.done():
+            fut.set_result(value)
+
+
+#: every constructed route's serving state; weak so finished graphs release
+#: their routes (the monitoring plane filters by the queried runtime)
+_ROUTES: "weakref.WeakSet[_RouteServing]" = weakref.WeakSet()
+
+
+def serving_status(runtime) -> dict[str, Any] | None:
+    """The ``/status`` serving section for one runtime's live routes, or None
+    when the run serves nothing."""
+    rows = sorted(
+        (rs.snapshot() for rs in list(_ROUTES) if rs.runtime is runtime),
+        key=lambda r: r["route"],
+    )
+    if not rows:
+        return None
+    return {
+        "routes": rows,
+        "requests_total": sum(r["requests_total"] for r in rows),
+        "responses_total": sum(r["responses_total"] for r in rows),
+        "shed_total": sum(r["shed_total"] for r in rows),
+    }
+
+
+def serving_prometheus_lines(runtime) -> list[str]:
+    """``pathway_serve_*`` exposition lines for ``/metrics``."""
+    from pathway_tpu.internals.monitoring import escape_label_value
+    from pathway_tpu.observability.metrics import BUCKET_BOUNDS_S
+
+    routes = [rs for rs in list(_ROUTES) if rs.runtime is runtime]
+    if not routes:
+        return []
+    routes.sort(key=lambda r: r.route)
+    lines: list[str] = []
+    counters = (
+        ("pathway_serve_requests_total", "Requests received by a REST route", "requests_total", "counter"),
+        ("pathway_serve_responses_total", "Responses served by a REST route", "responses_total", "counter"),
+        ("pathway_serve_shed_total", "Requests shed (429) by a REST route's admission", "shed_total", "counter"),
+        ("pathway_serve_errors_total", "Requests rejected (4xx) by a REST route", "errors_total", "counter"),
+        ("pathway_serve_inflight", "Requests admitted but not yet answered", None, "gauge"),
+    )
+    for name, help_text, attr, mtype in counters:
+        lines.append(f"# HELP {name} {help_text}")
+        lines.append(f"# TYPE {name} {mtype}")
+        for rs in routes:
+            label = f'route="{escape_label_value(rs.route)}"'
+            value = (
+                len(rs.futures) if attr is None else getattr(rs, attr)
+            )
+            lines.append(f"{name}{{{label}}} {value}")
+    lines.append("# HELP pathway_serve_latency_seconds Arrival-to-response latency per REST route")
+    lines.append("# TYPE pathway_serve_latency_seconds histogram")
+    for rs in routes:
+        label = f'route="{escape_label_value(rs.route)}"'
+        snap = rs.latency.snapshot()
+        cum = 0
+        for bound, c in zip(BUCKET_BOUNDS_S, snap["counts"]):
+            cum += c
+            lines.append(
+                f'pathway_serve_latency_seconds_bucket{{{label},le="{bound!r}"}} {cum}'
+            )
+        cum += snap["counts"][-1]
+        lines.append(
+            f'pathway_serve_latency_seconds_bucket{{{label},le="+Inf"}} {cum}'
+        )
+        lines.append(f"pathway_serve_latency_seconds_sum{{{label}}} {snap['sum_s']}")
+        lines.append(f"pathway_serve_latency_seconds_count{{{label}}} {snap['count']}")
+    return lines
+
+
+# --------------------------------------------------------------------- OpenAPI
+
+
+def _openapi_type(d: dt.DType) -> dict[str, Any]:
+    base = dt.unoptionalize(d)
+    if base == dt.INT:
+        return {"type": "integer"}
+    if base == dt.FLOAT:
+        return {"type": "number"}
+    if base == dt.BOOL:
+        return {"type": "boolean"}
+    if base == dt.STR:
+        return {"type": "string"}
+    if base == dt.JSON:
+        return {}  # any JSON value
+    return {}
+
+
+def openapi_spec(webserver: "PathwayWebserver") -> dict[str, Any]:
+    """OpenAPI 3 document generated from the registered routes' Pathway
+    schemas + ``documentation`` metadata (served at ``/_schema``)."""
+    paths: dict[str, dict] = {}
+    for route, methods, _handler, meta in webserver._routes:
+        if meta is None:
+            continue
+        schema = meta.get("schema")
+        doc = meta.get("documentation")
+        props: dict[str, Any] = {}
+        required: list[str] = []
+        if schema is not None:
+            for name, cdef in schema.columns().items():
+                spec = _openapi_type(cdef.dtype)
+                if cdef.has_default and cdef.default_value is not None:
+                    spec = {**spec, "default": _jsonable(cdef.default_value)}
+                props[name] = spec
+                if not cdef.has_default and not isinstance(cdef.dtype, dt.Optional):
+                    required.append(name)
+        body_schema: dict[str, Any] = {"type": "object", "properties": props}
+        if required:
+            body_schema["required"] = required
+        responses = {
+            "200": {
+                "description": "query answered as-of-now",
+                "content": {"application/json": {"schema": {}}},
+            },
+            "400": {"description": "malformed payload or request_validator rejection"},
+            "429": {
+                "description": "admission shed (in-flight budget or ingest credit exhausted); retry after the Retry-After seconds",
+            },
+            "503": {"description": "engine shutting down; request not processed"},
+            "504": {"description": "engine produced no answer within the request timeout"},
+        }
+        item: dict[str, Any] = {}
+        for m in methods:
+            op: dict[str, Any] = {
+                "operationId": f"{m.lower()}_{route.strip('/').replace('/', '_') or 'root'}",
+                "responses": responses,
+            }
+            if doc is not None:
+                summary = getattr(doc, "summary", None) or (
+                    doc.get("summary") if isinstance(doc, dict) else None
+                )
+                description = getattr(doc, "description", None) or (
+                    doc.get("description") if isinstance(doc, dict) else None
+                )
+                tags = getattr(doc, "tags", None) or (
+                    doc.get("tags") if isinstance(doc, dict) else None
+                )
+                if summary:
+                    op["summary"] = summary
+                if description:
+                    op["description"] = description
+                if tags:
+                    op["tags"] = list(tags)
+            if m.upper() == "GET":
+                op["parameters"] = [
+                    {
+                        "name": name,
+                        "in": "query",
+                        "required": name in required,
+                        "schema": spec,
+                    }
+                    for name, spec in props.items()
+                ]
+            else:
+                op["requestBody"] = {
+                    "required": bool(required),
+                    "content": {"application/json": {"schema": body_schema}},
+                }
+            item[m.lower()] = op
+        paths[route] = item
+    return {
+        "openapi": "3.0.3",
+        "info": {"title": "pathway_tpu serving plane", "version": "1"},
+        "paths": paths,
+    }
+
+
+# ------------------------------------------------------------------- webserver
+
+
 class PathwayWebserver:
     """One aiohttp server shared by many rest_connector routes
-    (reference ``_server.py:329``)."""
+    (reference ``_server.py:329``). ``stop()`` is synchronous and complete:
+    it flushes pending request futures, awaits the runner's cleanup on the
+    server loop and joins the thread — the port is free when it returns, so
+    two back-to-back runs can bind the same address."""
 
     def __init__(self, host: str = "0.0.0.0", port: int = 8080, with_cors: bool = False):
         self.host = host
         self.port = port
         self.with_cors = with_cors
-        self._routes: list[tuple[str, list[str], Any]] = []
+        #: (route, methods, handler, meta) — meta carries schema/documentation
+        #: for OpenAPI generation and the serving state for lifecycle flushes
+        self._routes: list[tuple[str, list[str], Any, dict | None]] = []
         self._started = threading.Event()
         self._loop: asyncio.AbstractEventLoop | None = None
         self._thread: threading.Thread | None = None
         self._runner = None
+        self._start_error: BaseException | None = None
 
-    def _add_route(self, route: str, methods: list[str], handler: Any) -> None:
-        self._routes.append((route, methods, handler))
+    def _add_route(
+        self, route: str, methods: list[str], handler: Any, meta: dict | None = None
+    ) -> None:
+        self._routes.append((route, methods, handler, meta))
+
+    def _route_states(self) -> list[_RouteServing]:
+        return [
+            m["serving"]
+            for _r, _m, _h, m in self._routes
+            if m is not None and m.get("serving") is not None
+        ]
 
     def start(self) -> None:
         if self._thread is not None:
@@ -66,30 +458,77 @@ class PathwayWebserver:
         import aiohttp.web as web
 
         app = web.Application()
-        for route, methods, handler in self._routes:
+        for route, methods, handler, _meta in self._routes:
             for m in methods:
                 app.router.add_route(m, route, handler)
+
+        async def schema_handler(_request: "web.Request") -> "web.Response":
+            return web.json_response(openapi_spec(self))
+
+        app.router.add_route("GET", "/_schema", schema_handler)
+
+        self._started.clear()
+        self._start_error = None
 
         def serve() -> None:
             loop = asyncio.new_event_loop()
             asyncio.set_event_loop(loop)
             self._loop = loop
             runner = web.AppRunner(app)
-            loop.run_until_complete(runner.setup())
-            site = web.TCPSite(runner, self.host, self.port)
-            loop.run_until_complete(site.start())
+            try:
+                loop.run_until_complete(runner.setup())
+                site = web.TCPSite(runner, self.host, self.port)
+                loop.run_until_complete(site.start())
+            except BaseException as e:  # bind failure -> surface in start()
+                self._start_error = e
+                self._started.set()
+                loop.close()
+                return
             self._runner = runner
             self._started.set()
             loop.run_forever()
-            loop.run_until_complete(runner.cleanup())
+            # stop() already awaited runner.cleanup() via the loop; anything
+            # else scheduled is drained by closing
+            loop.close()
 
         self._thread = threading.Thread(target=serve, daemon=True)
         self._thread.start()
         self._started.wait(timeout=10)
+        if self._start_error is not None:
+            err, self._start_error = self._start_error, None
+            self._thread = None
+            self._loop = None
+            raise RuntimeError(
+                f"PathwayWebserver failed to bind {self.host}:{self.port}: {err!r}"
+            ) from err
 
     def stop(self) -> None:
-        if self._loop is not None:
-            self._loop.call_soon_threadsafe(self._loop.stop)
+        thread, loop = self._thread, self._loop
+        if thread is None or loop is None:
+            return
+        # unblock waiting clients first: their handlers answer 503 while the
+        # server is still accepting writes
+        for rs in self._route_states():
+            rs.flush_pending()
+        runner = self._runner
+        if runner is not None:
+            try:
+                # graceful: waits for in-flight handlers, closes the site
+                # sockets — the port is released here, not at thread death
+                asyncio.run_coroutine_threadsafe(
+                    runner.cleanup(), loop
+                ).result(timeout=10)
+            except Exception:
+                pass
+        try:
+            loop.call_soon_threadsafe(loop.stop)
+        except RuntimeError:
+            pass
+        thread.join(timeout=10)
+        self._thread = None
+        self._loop = None
+        self._runner = None
+        self._started.clear()
 
 
 class _RestDriver:
@@ -97,25 +536,45 @@ class _RestDriver:
 
     virtual = False
 
-    def __init__(self, webserver: PathwayWebserver):
+    def __init__(self, webserver: PathwayWebserver, state: _RouteServing):
         self.webserver = webserver
+        self.state = state
 
     def start(self) -> None:
+        self.state.configure()
         self.webserver.start()
 
     def is_finished(self) -> bool:
         return False  # unbounded; stopped via runtime.request_stop()
 
     def stop(self) -> None:
+        # flush BEFORE the server goes down so every pending client gets a
+        # fast 503 through a still-open connection, then release the port
+        self.state.flush_pending()
         self.webserver.stop()
 
 
-class _RestState:
-    def __init__(self) -> None:
-        self.node: ops.StreamInputNode | None = None
-        self.futures: dict[int, asyncio.Future] = {}
-        self.seq = 0
-        self.lock = threading.Lock()
+# --------------------------------------------------------------- rest_connector
+
+
+def _coerce(v: Any, d: dt.DType) -> Any:
+    """GET query params arrive as strings; coerce to the schema dtype the
+    POST/JSON path would have produced."""
+    base = dt.unoptionalize(d)
+    if v is None or not isinstance(v, str) or base == dt.STR:
+        return v
+    try:
+        if base == dt.INT:
+            return int(v)
+        if base == dt.FLOAT:
+            return float(v)
+        if base == dt.BOOL:
+            return v.strip().lower() not in ("", "0", "false", "no")
+        if base == dt.JSON:
+            return _json.loads(v)
+    except (ValueError, TypeError):
+        return v  # schema validation downstream reports it
+    return v
 
 
 def rest_connector(
@@ -132,20 +591,58 @@ def rest_connector(
     request_validator: Any = None,
     documentation: Any = None,
 ) -> tuple[Table, Any]:
-    """Returns ``(queries_table, response_writer)``."""
+    """Returns ``(queries_table, response_writer)``.
+
+    ``delete_completed_queries`` / ``keep_queries``: once a query's response
+    is served, its row is retracted from the queries table (so downstream
+    state doesn't grow with request history) unless ``keep_queries=True``;
+    an explicit ``delete_completed_queries`` wins over ``keep_queries``.
+    """
     ws = webserver or PathwayWebserver(host=host, port=port)
     if schema is None:
         schema = schema_mod.schema_from_types(query=str)
     columns = schema.column_names()
     np_dtypes = schema.np_dtypes()
     dtypes = schema.dtypes()
-    state = _RestState()
+    defaults = schema.default_values()
+    state = _RouteServing(route, methods, schema)
+    state.delete_completed = (
+        delete_completed_queries
+        if delete_completed_queries is not None
+        else not keep_queries
+    )
+    _ROUTES.add(state)
 
     import aiohttp.web as web
 
+    def _shed_response(reason: str):
+        state.shed_total += 1
+        from pathway_tpu import observability as _obs
+
+        tracer = _obs.current()
+        if tracer is not None:
+            tracer.event(
+                "serve/shed", {"pathway.route": route, "pathway.reason": reason}
+            )
+        status = 503 if reason == "shutting_down" else 429
+        return web.json_response(
+            {"error": "overloaded", "reason": reason},
+            status=status,
+            headers={"Retry-After": "1"},
+        )
+
     async def handler(request: "web.Request") -> "web.Response":
+        state.requests_total += 1
+        shed = state.try_admit()
+        if shed is not None:
+            return _shed_response(shed)
         if request.method == "GET":
-            payload = dict(request.rel_url.query)
+            # keep EVERY query param (request_validator may inspect extras);
+            # coerce only the schema-typed ones
+            payload = {
+                k: _coerce(v, dtypes[k]) if k in dtypes else v
+                for k, v in request.rel_url.query.items()
+            }
         else:
             try:
                 payload = await request.json()
@@ -155,63 +652,149 @@ def rest_connector(
             try:
                 request_validator(payload)
             except Exception as e:
+                state.errors_total += 1
                 return web.json_response({"error": str(e)}, status=400)
         values = []
         for c in columns:
-            v = payload.get(c)
+            v = payload.get(c, defaults.get(c))
             d = dt.unoptionalize(dtypes[c])
             if d == dt.JSON and v is not None and not isinstance(v, Json):
                 v = Json(v)
             values.append(v)
+        values = tuple(values)
+        arrival_ns = _time_mod.time_ns()
+        fut: asyncio.Future = asyncio.get_running_loop().create_future()
+        loop = fut.get_loop()
         with state.lock:
+            if state.closed:
+                return _shed_response("shutting_down")
+            if len(state.futures) >= state.max_inflight:
+                # re-check under the registration lock: the arrival-time check
+                # ran BEFORE awaiting the request body, and any number of
+                # handlers can suspend there — the budget must bind where the
+                # futures dict actually grows
+                return _shed_response("max_inflight")
             state.seq += 1
             key = int(splitmix64(np.asarray([state.seq], dtype=np.uint64))[0])
-        fut: asyncio.Future = asyncio.get_running_loop().create_future()
-        state.futures[key] = fut
-        assert state.node is not None, "rest_connector: engine not running"
-        state.node.push(key, tuple(values), 1)
+            state.futures[key] = (fut, loop, arrival_ns, values)
+        if not state.push_admitted(key, values):
+            with state.lock:
+                state.futures.pop(key, None)
+            return _shed_response("no_ingest_credit")
+        state.schedule_tick()
         try:
-            result = await asyncio.wait_for(fut, timeout=120)
+            result = await asyncio.wait_for(fut, timeout=_REQUEST_TIMEOUT_S)
         except asyncio.TimeoutError:
-            state.futures.pop(key, None)
+            with state.lock:
+                ent = state.futures.pop(key, None)
+            state.timeouts_total += 1
+            # ent None = the response side won the race and already owns the
+            # retraction; retracting again would push an unpaired -1
+            if ent is not None and state.delete_completed and state.node is not None:
+                # nobody is waiting anymore: retract the query row so the
+                # engine doesn't keep dead-request state forever (the normal
+                # retraction happens at response time, which never came)
+                state.node._append_events([(key, values, -1)])
+                state.schedule_tick()
             return web.json_response({"error": "timeout"}, status=504)
+        if result is _SHUTDOWN:
+            return web.json_response(
+                {"error": "engine shutting down"}, status=503
+            )
         return web.json_response(_jsonable(result))
 
-    ws._add_route(route, list(methods), handler)
+    ws._add_route(
+        route,
+        list(methods),
+        handler,
+        meta={"schema": schema, "documentation": documentation, "serving": state},
+    )
 
     def factory() -> Node:
         node = ops.StreamInputNode(columns, np_dtypes)
+        node.input_name = f"rest:{route}"
         state.node = node
         return node
 
     def hook(node: Node, runtime: Any) -> None:
         if runtime is not None:
-            runtime.register_connector(_RestDriver(ws))
+            state.runtime = runtime
+            runtime.register_connector(_RestDriver(ws, state))
 
     lnode = LogicalNode(factory, [], name=f"rest:{route}", runtime_hook=hook)
     queries = Table(lnode, schema, Universe())
 
     def response_writer(result_table: Table) -> None:
         cols = result_table.column_names()
+        collected: list[tuple[int, dict]] = []
 
         def on_change(key: int, row: dict, time: int, is_addition: bool) -> None:
-            if not is_addition:
+            if is_addition:
+                collected.append((int(key), row))
+
+        def on_time_end(time: int) -> None:
+            if not collected:
                 return
-            fut = state.futures.pop(int(key), None)
-            if fut is None:
+            batch = collected[:]
+            collected.clear()
+            now_ns = _time_mod.time_ns()
+            resolved: list[tuple[tuple, int, dict]] = []
+            with state.lock:
+                for key, row in batch:
+                    ent = state.futures.pop(key, None)
+                    if ent is not None:
+                        resolved.append((ent, key, row))
+                state.arrivals_since_wake = 0
+            if not resolved:
                 return
-            if "result" in row and len(cols) <= 2:
-                value = row["result"]
-            else:
-                value = row
-            loop = fut.get_loop()
-            loop.call_soon_threadsafe(
-                lambda: fut.set_result(value) if not fut.done() else None
-            )
+            # one vectorized resolution pass per event loop, not a
+            # call_soon_threadsafe per row
+            by_loop: dict[Any, list] = {}
+            oldest_ns = now_ns
+            retracts: list[tuple[int, tuple, int]] = []
+            for (fut, loop, arrival_ns, values), key, row in resolved:
+                value = (
+                    row["result"] if "result" in row and len(cols) <= 2 else row
+                )
+                by_loop.setdefault(loop, []).append((fut, value))
+                state.latency.observe((now_ns - arrival_ns) / 1e9)
+                oldest_ns = min(oldest_ns, arrival_ns)
+                if state.delete_completed:
+                    retracts.append((key, values, -1))
+            for loop, items in by_loop.items():
+                try:
+                    loop.call_soon_threadsafe(_set_results, items)
+                except RuntimeError:
+                    pass  # server stopping; flush_pending owns these clients
+            state.responses_total += len(resolved)
+            state.batches_total += 1
+            state.batched_rows_total += len(resolved)
+            from pathway_tpu import observability as _obs
+
+            tracer = _obs.current()
+            if tracer is not None:
+                tracer.span(
+                    "serve/respond",
+                    oldest_ns,
+                    now_ns,
+                    {
+                        "pathway.route": route,
+                        "pathway.responses": len(resolved),
+                        "pathway.tick": time,
+                    },
+                )
+            if retracts and state.node is not None:
+                # retract served query rows (delete_completed_queries): this
+                # is the server's own bookkeeping, bounded by the in-flight
+                # budget, pushed from the engine thread — it must NOT take
+                # the ingest credit path (admit_retract could wait on credits
+                # that only replenish when THIS tick finishes: deadlock), so
+                # it appends directly; the rows drain on the next tick
+                state.node._append_events(retracts)
 
         from pathway_tpu.io._subscribe import subscribe
 
-        subscribe(result_table, on_change)
+        subscribe(result_table, on_change, on_time_end=on_time_end)
 
     return queries, response_writer
 

@@ -443,6 +443,11 @@ class ClusterRuntime:
         self._stop_requested = False
         self.streaming = False  # set after build (see engine.runtime.Runtime)
         self.current_time = 0
+        # arrival-driven tick scheduling: the coordinator (pid 0) owns the
+        # inter-tick sleep, so REST wakeups there drive the whole pod
+        from pathway_tpu.engine.runtime import TickWakeup
+
+        self.wakeup = TickWakeup()
         # live tracing (observability): installed in run(), None when off
         self.tracer = None
         self._trace_active = False
@@ -896,7 +901,7 @@ class ClusterRuntime:
                 if self.pid == 0 and self.connectors and not all_virtual:
                     elapsed = _time.perf_counter() - t0
                     if elapsed < period:
-                        _time.sleep(period - elapsed)
+                        self.wakeup.wait(period - elapsed)
         finally:
             for driver in self.connectors:
                 driver.stop()

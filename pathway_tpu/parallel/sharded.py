@@ -80,6 +80,10 @@ class ShardedRuntime:
         self.streaming = False  # set after build (see engine.runtime.Runtime)
         self.current_time = 0
         self.on_tick_done: list[Any] = []
+        # arrival-driven tick scheduling (REST serving plane wakeups)
+        from pathway_tpu.engine.runtime import TickWakeup
+
+        self.wakeup = TickWakeup()
         # live tracing (observability): installed in run(), None when off
         self.tracer = None
         self._trace_active = False
@@ -376,7 +380,7 @@ class ShardedRuntime:
                 if not all_virtual:
                     elapsed = _time.perf_counter() - t0
                     if elapsed < period:
-                        _time.sleep(period - elapsed)
+                        self.wakeup.wait(period - elapsed)
         finally:
             for driver in self.connectors:
                 driver.stop()

@@ -213,12 +213,24 @@ class DataIndex:
         agg = {n: pw.reducers.tuple(matched[n]) for n in data_cols}
         agg[_SCORE] = pw.reducers.tuple(matched["__score"])
         collapsed = grouped.reduce(**agg)
-        # queries with zero matches have no group — pad with None over the full
-        # query universe (reference: left join; DocumentStore coalesces to ())
+        # queries with zero matches have no group — pad with None (reference:
+        # left join; DocumentStore coalesces to ())
         out_cols = data_cols + [_SCORE]
-        base = qtable.select(
-            **{n: pw.declare_type(dt.ANY, None) for n in out_cols}
-        )
+        if as_of_now:
+            # serving discipline: an as-of-now query emits NOTHING until the
+            # index has answered it, so the pad universe is the ANSWERED
+            # replies (``rep`` rows exist exactly then; ``()`` for zero
+            # matches). Padding over the full query universe raced the
+            # answer: the reply can land ticks after arrival (the query
+            # embedding rides the cross-tick microbatch path), and a REST
+            # client would be resolved with the provisional padded row.
+            base = rep.select(
+                **{n: pw.declare_type(dt.ANY, None) for n in out_cols}
+            )
+        else:
+            base = qtable.select(
+                **{n: pw.declare_type(dt.ANY, None) for n in out_cols}
+            )
         padded = base.update_cells(collapsed.promise_universe_is_subset_of(base))
         return _DataIndexResult(qtable, padded, left_to_right_universe=True)
 

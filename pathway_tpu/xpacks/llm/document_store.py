@@ -37,10 +37,11 @@ class DocumentStore:
     def __init__(
         self,
         docs: Table | Iterable[Table],
-        retriever_factory: AbstractRetrieverFactory,
+        retriever_factory: AbstractRetrieverFactory | None = None,
         parser: Callable | None = None,
         splitter: Callable | None = None,
         doc_post_processors: list[Callable] | None = None,
+        embedder: Any = None,
     ):
         from pathway_tpu.xpacks.llm.parsers import Utf8Parser
         from pathway_tpu.xpacks.llm.splitters import NullSplitter
@@ -52,6 +53,20 @@ class DocumentStore:
             self.docs = (
                 tables[0] if len(tables) == 1 else tables[0].concat_reindex(*tables[1:])
             )
+        if retriever_factory is None:
+            # default big-corpus retriever (ROADMAP #4 headroom): the tiered
+            # hot-HBM/cold-host index serves any corpus size at a fixed device
+            # footprint (PATHWAY_INDEX_HOT_ROWS) and answers byte-identically
+            # to brute force while the cold tier is exact — small corpora
+            # never spill past the hot shard, so nothing is lost by default
+            if embedder is None:
+                raise ValueError(
+                    "DocumentStore: provide retriever_factory= or embedder= "
+                    "(the default TieredKnnFactory embeds with it)"
+                )
+            from pathway_tpu.stdlib.indexing.retrievers import TieredKnnFactory
+
+            retriever_factory = TieredKnnFactory(embedder=embedder)
         self.retriever_factory = retriever_factory
         self.parser = parser or Utf8Parser()
         self.splitter = splitter or NullSplitter()

@@ -57,8 +57,11 @@ class SentenceTransformerEmbedder(BaseEmbedder):
         *,
         seed: int = 0,
         params: Any = None,
+        memoize: int = 0,
         **kwargs,
     ):
+        from collections import OrderedDict
+
         from pathway_tpu.ops.encoder import EncoderConfig, JaxSentenceEncoder
 
         if isinstance(model, EncoderConfig):
@@ -70,10 +73,59 @@ class SentenceTransformerEmbedder(BaseEmbedder):
         if params is not None:
             self._encoder.params = params
         encoder = self._encoder
+        # serving-tier embedding memo (``memoize`` = LRU entry bound, 0 = off):
+        # a text seen before returns its stored vector without a device launch.
+        # In a RAG serving loop this removes the rerank stage's re-encode of
+        # corpus documents and collapses microbatch pad replicas (pads
+        # duplicate real rows, so in-batch dedupe encodes them once). Opt-in:
+        # the encoder's length-bucketing pads by batch composition, so a
+        # memoized vector can differ in final float bits from a fresh
+        # mixed-length batch — the same recompute caveat ``deterministic``
+        # already accepts, but off by default to keep r6-era runs bit-stable.
+        self._memo: "OrderedDict[str, np.ndarray]" = OrderedDict()
+        self._memo_cap = max(0, int(memoize))
+        self.memo_hits = 0
+        self.memo_misses = 0
 
         def embed_batch(texts: list[str]) -> list[np.ndarray]:
-            embs = encoder.encode_texts([str(t) for t in texts])
-            return list(embs)
+            texts = [str(t) for t in texts]
+            if not self._memo_cap:
+                return list(encoder.encode_texts(texts))
+            memo = self._memo
+            out: list[Any] = [None] * len(texts)
+            want: dict[str, list[int]] = {}
+            for i, t in enumerate(texts):
+                v = memo.get(t)
+                if v is not None:
+                    memo.move_to_end(t)
+                    out[i] = v
+                    self.memo_hits += 1
+                else:
+                    want.setdefault(t, []).append(i)
+            if want:
+                from pathway_tpu.ops.microbatch import bucket_size
+
+                miss_texts = list(want)
+                self.memo_misses += len(miss_texts)
+                # re-pad the deduped misses to power-of-two buckets, chunked
+                # at the microbatch launch cap: callers (the microbatch
+                # dispatcher) padded THEIR batch, but dedupe shrank it to the
+                # unique count — an arbitrary (or oversized) batch dim would
+                # grow the encoder's jit shape set without bound
+                cap = int(getattr(self, "microbatch_max_batch", 512))
+                for lo in range(0, len(miss_texts), cap):
+                    chunk = miss_texts[lo : lo + cap]
+                    m = len(chunk)
+                    padded = bucket_size(m, min_bucket=8, max_bucket=cap)
+                    launch = chunk + [chunk[0]] * (padded - m)
+                    for t, v in zip(chunk, encoder.encode_texts(launch)[:m]):
+                        v = np.asarray(v)
+                        for i in want[t]:
+                            out[i] = v
+                        memo[t] = v
+                while len(memo) > self._memo_cap:
+                    memo.popitem(last=False)
+            return out
 
         # deterministic: fixed weights, pure forward pass — lets the
         # microbatch node recompute retract rows instead of remembering

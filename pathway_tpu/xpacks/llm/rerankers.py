@@ -61,9 +61,14 @@ class EncoderReranker(UDF):
         embed = embedder.func  # raw batch callable (texts -> vectors)
 
         def score_batch(docs: list[str], queries: list[str]) -> list[float]:
-            dv = np.stack(embed([str(d) for d in docs]))
-            qv = np.stack(embed([str(q) for q in queries]))
-            return [float(x) for x in np.sum(dv * qv, axis=-1)]
+            # one combined launch for docs + queries: half the padded-bucket
+            # dispatches of two separate embed calls, and the bigger batch
+            # runs closer to the device's best rate
+            n = len(docs)
+            vecs = np.stack(
+                embed([str(d) for d in docs] + [str(q) for q in queries])
+            )
+            return [float(x) for x in np.sum(vecs[:n] * vecs[n:], axis=-1)]
 
         kwargs.setdefault("deterministic", True)  # fixed weights, pure forward
         super().__init__(_fn=score_batch, return_type=float, **kwargs)

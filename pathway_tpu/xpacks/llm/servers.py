@@ -12,7 +12,11 @@ import threading
 from typing import Any, Callable
 
 import pathway_tpu as pw
-from pathway_tpu.io.http._server import PathwayWebserver, rest_connector
+from pathway_tpu.io.http._server import (
+    EndpointDocumentation,
+    PathwayWebserver,
+    rest_connector,
+)
 
 
 class BaseRestServer:
@@ -21,9 +25,17 @@ class BaseRestServer:
         self.port = port
         self.webserver = PathwayWebserver(host=host, port=port)
 
-    def serve(self, route: str, schema, handler: Callable, **kwargs) -> None:
+    def serve(
+        self, route: str, schema, handler: Callable, documentation=None, **kwargs
+    ) -> None:
         queries, writer = rest_connector(
-            webserver=self.webserver, route=route, schema=schema, methods=("GET", "POST")
+            webserver=self.webserver,
+            route=route,
+            schema=schema,
+            methods=("GET", "POST"),
+            documentation=documentation
+            or EndpointDocumentation(summary=f"{type(self).__name__} {route}"),
+            **kwargs,
         )
         writer(handler(queries))
 

@@ -137,6 +137,7 @@ def test_shed_never_drops_retractions(monkeypatch):
 def test_bulk_only_pipeline_not_self_throttled(monkeypatch):
     # a full BULK queue is ordinary bounded backpressure: it must not feed
     # the pressure signal that budgets bulk admission (self-throttle loop)
+    obs_metrics.reset()  # sink histograms from earlier tests are not pressure
     plane = _install(monkeypatch, PATHWAY_INPUT_QUEUE_ROWS=10)
     node = ops.StreamInputNode(["x"])
     node.service_class = "bulk"
@@ -285,6 +286,29 @@ def test_admission_budgets_by_class_and_pressure():
     assert bulk.budget == 16  # guaranteed minimum: backfill never starves
     sched.plan([inter, bulk], pressure=0.1)
     assert bulk.budget is None  # below the floor: no throttling
+
+
+def test_admission_standing_bulk_ceiling():
+    # PATHWAY_FLOW_BULK_MAX_ROWS (r14): the pressure signal is reactive, so
+    # bulk rows with real device cost (serving-tier doc-ingest embeds) get a
+    # standing per-tick drain ceiling that holds even at ZERO pressure
+    sched = AdmissionScheduler(bulk_min_rows=8, bulk_max_rows=32)
+    inter, bulk = _gate_like("interactive"), _gate_like("bulk")
+    sched.plan([inter, bulk], pressure=0.0)
+    assert inter.budget is None  # interactive is never budgeted
+    assert bulk.budget == 32  # ceiling applies with no pressure at all
+    sched.plan([inter, bulk], pressure=0.75)
+    assert bulk.budget == 25  # pressure back-off may go below the ceiling
+    sched.plan([inter, bulk], pressure=1.0)
+    assert bulk.budget == 8  # floor still guaranteed
+    # the ceiling never undercuts the under-pressure progress guarantee
+    sched_low = AdmissionScheduler(bulk_min_rows=64, bulk_max_rows=16)
+    sched_low.plan([bulk], pressure=1.0)
+    assert bulk.budget == 64
+    # default 0 = unlimited, byte-for-byte the r9 plan
+    sched_r9 = AdmissionScheduler(bulk_min_rows=8)
+    sched_r9.plan([bulk], pressure=0.0)
+    assert bulk.budget is None
 
 
 # -------------------------------------------------------------- controller

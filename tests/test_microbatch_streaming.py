@@ -206,6 +206,51 @@ def test_flush_on_deadline_ordering():
     assert len(node.waiting) == 4
 
 
+def _make_deterministic_node(max_batch=8, runtime=None):
+    """All-deterministic spec: the node keeps NO ``emitted`` state — retracts
+    of settled rows hit the r14 bounded replay cache (or recompute on miss)."""
+    calls: list[int] = []
+
+    def fn(xs):
+        calls.append(len(xs))
+        return [x * 2 for x in xs]
+
+    def args_program(batch):
+        return [np.asarray(batch.data["x"])], []
+
+    spec = MicrobatchUdfSpec("y", args_program, fn, [], False, deterministic=True)
+    node = MicrobatchApplyNode(
+        ["y"], [], lambda b: {}, [spec],
+        np_dtypes={"y": np.dtype(np.int64)},
+        max_batch=max_batch, runtime=runtime,
+    )
+    return node, calls
+
+
+def test_settled_retract_replays_cached_output_without_relaunch():
+    """r14 serving hot path: a retract of a recently-emitted row (the
+    delete_completed_queries pattern — every served query row is retracted
+    one tick later) must replay the cached output, NOT re-run the device UDF
+    in a tiny padded launch."""
+    rt = _FakeRuntime()
+    node, calls = _make_deterministic_node(max_batch=8, runtime=rt)
+    assert not node._remember  # deterministic: no emitted-row state
+    node.process([_batch([5], [21], 0)], 0)
+    time.sleep(0.01)
+    [b0] = node.on_frontier(1)
+    assert calls == [8] and b0.data["y"].tolist() == [42]
+    # settled retract: answered from the replay cache, zero launches
+    [b1] = node.process([_batch([5], [21], 2, diffs=[-1])], 2)
+    assert b1.diffs.tolist() == [-1]
+    assert b1.data["y"].tolist() == [42]
+    assert calls == [8], "retract must not re-launch the UDF"
+    # a REUSED key with different input values must miss the cache (the
+    # signature guard) and fall back to recompute — correctness over speed
+    [b2] = node.process([_batch([5], [50], 3, diffs=[-1])], 3)
+    assert b2.data["y"].tolist() == [100]
+    assert len(calls) == 2  # the recompute launched
+
+
 def test_cross_tick_upsert_out_of_order_retract():
     """A key with BOTH a settled row and a newer buffered version: a retract
     must target whichever version its input values match — the old settled row
