@@ -9,6 +9,17 @@ attributes it:
 
 - ``python benchmarks/engine_bench.py [N] [N_TIMES]`` — one run, one JSON
   line (the r1-era interface, kept for ad-hoc probes).
+- ``python benchmarks/engine_bench.py --small-ticks [ROWS ...]`` — the r15
+  protocol: a deep stateless transform chain (filters / arithmetic maps /
+  projections — the row-microbatch shape of RAG preprocessing pipelines)
+  driven by pre-columnar delta blocks at 64/256/1024 rows per tick,
+  PATHWAY_FUSE=on vs off interleaved best-of-``REPS``. ``off`` is the
+  verbatim r14 engine (full-scan sweep, one dispatch per node), so the A/B
+  measures the whole-tick fused dispatch win; outputs are asserted
+  byte-identical in-bench, and a quiescent-tick rate (empty ticks — the
+  no-op sweep short-circuit) rides along. Gate: ``small_tick_speedup_64``
+  must stay >= the committed BENCH value minus ``GATE_SPEEDUP_DROP`` under
+  ``BENCH_MODE=1`` (noisy-host downgrade as below).
 - ``python benchmarks/engine_bench.py --full [N]`` — the r11 protocol:
   interleaved best-of-``REPS`` static (one load) vs incremental (the same
   rows over ``N_TIMES`` logical timestamps), a per-phase tick breakdown of
@@ -93,8 +104,147 @@ def run(n: int = 1_000_000, n_times: int = 1) -> dict:
     }
 
 
-def _last_committed_pct(exclude: str | None = None) -> tuple[float, str] | None:
-    """Newest committed BENCH_r*.json carrying the pct metric."""
+# ------------------------------------------------------------- small ticks (r15)
+
+SMALL_TICKS = 300
+GATE_SPEEDUP_DROP = 1.0  # allowed drop in small_tick_speedup_64 vs committed
+
+
+def _small_tick_pipeline(blocks):
+    """An 18-operator stateless transform chain — filters, arithmetic maps
+    and the projection/rename plumbing real row-microbatch pipelines stack
+    up (the reference's DocumentStore preprocessing shape: parse → unpack →
+    select → rename → filter → select …). Fed by pre-columnar delta blocks:
+    the engine's native unit, isolating the per-tick SWEEP cost from
+    connector-side row materialization."""
+    import pathway_tpu as pw
+    from pathway_tpu.internals.logical import LogicalNode
+    from pathway_tpu.internals.parse_graph import G
+    from pathway_tpu.internals.table import Table
+    from pathway_tpu.internals.universe import Universe
+
+    G.clear()
+    src = LogicalNode(lambda: _BlockReplayNode(blocks), [], name="block_replay")
+    schema = pw.schema_from_types(k=int, v=int, x=float)
+    t = Table(src, schema, Universe())
+    f = t.filter(t.v > 2)
+    a = f.select(k=f.k, v=f.v, x=f.x, y=f.v * 3)
+    b = a.select(k=a.k, v=a.v, x=a.x, z=a.y + a.v)
+    b = b.rename(vv=b.v)
+    c = b.select(k=b.k, v=b.vv, x=b.x, w=pw.if_else(b.x > 5.0, b.x, -b.x), z=b.z)
+    d = c.filter(c.z < 400)
+    e = d.select(k=d.k, v=d.v, x=d.x, s=d.z * 2 + d.v, w=d.w)
+    e = e.select(k=e.k, v=e.v, x=e.x, s=e.s, w=e.w)  # projection plumbing
+    g = e.select(k=e.k, v=e.v, x=e.x, s=e.s, w=e.w, q=e.s - e.v)
+    h = g.filter(g.q >= 0)
+    i = h.select(k=h.k, v=h.v, r=h.q * 3 + h.v, w=h.w, x=h.x)
+    i = i.rename(rr=i.r)
+    j = i.select(k=i.k, u=pw.if_else(i.rr > 100, i.rr, -i.rr), w=i.w, x=i.x, v=i.v)
+    kk = j.filter(j.u < 3000)
+    ll = kk.select(k=kk.k, u=kk.u, w=kk.w + kk.x, v=kk.v)
+    return ll.select(k=ll.k, final=ll.u + ll.v, w=ll.w)
+
+
+class _BlockReplayNode:
+    """Source emitting one pre-built DeltaBatch per tick (defined lazily as
+    a real Node subclass on first use — module import stays engine-free)."""
+
+    def __new__(cls, blocks):
+        from pathway_tpu.engine.blocks import DeltaBatch
+        from pathway_tpu.engine.graph import END_OF_STREAM, SOLO, Node
+
+        class _Replay(Node):
+            name = "block_replay"
+
+            def __init__(self, blocks):
+                super().__init__(n_inputs=0)
+                self.blocks = blocks
+                self.i = 0
+
+            def exchange_key(self, port):
+                return SOLO
+
+            def poll(self, t):
+                if t == END_OF_STREAM or self.i >= len(self.blocks):
+                    return []
+                b = self.blocks[self.i]
+                self.i += 1
+                # blocks are pre-stamped with their tick time and freshly
+                # built per run — emit directly, like a columnar connector
+                return [b]
+
+        return _Replay(blocks)
+
+
+class _TickDriver:
+    """Virtual connector driving exactly ``n`` engine ticks, no sleeps."""
+
+    virtual = True
+
+    def __init__(self, n: int):
+        self.n = n
+        self.t = 0
+
+    def start(self) -> None: ...
+
+    def stop(self) -> None: ...
+
+    def is_finished(self) -> bool:
+        self.t += 1
+        return self.t >= self.n
+
+
+def _small_tick_blocks(rpt: int, n_ticks: int, seed: int = 3):
+    from pathway_tpu.engine.blocks import DeltaBatch
+
+    rng = np.random.default_rng(seed)
+    return [
+        DeltaBatch(
+            rng.integers(0, 1 << 62, rpt).astype(np.uint64),
+            np.ones(rpt, dtype=np.int64),
+            {
+                "k": rng.integers(0, 1000, rpt).astype(np.int64),
+                "v": rng.integers(0, 100, rpt).astype(np.int64),
+                "x": rng.random(rpt) * 10,
+            },
+            t,
+        )
+        for t in range(n_ticks)
+    ]
+
+
+def _small_tick_run(rpt: int, n_ticks: int) -> tuple[float, dict]:
+    """One engine run over ``n_ticks`` blocks; returns (engine seconds,
+    final captured state). rpt=0 drives EMPTY ticks (quiescence cost)."""
+    from pathway_tpu.engine import operators as ops
+    from pathway_tpu.engine.runtime import Runtime
+    from pathway_tpu.internals.logical import LogicalNode
+
+    blocks = _small_tick_blocks(rpt, n_ticks) if rpt else _small_tick_blocks(64, 1)
+    table = _small_tick_pipeline(blocks)
+    holder: dict = {}
+    cols = table.column_names()
+
+    def factory():
+        holder["n"] = ops.CaptureNode(cols)
+        return holder["n"]
+
+    ln = LogicalNode(factory, [table._node], name="capture")
+    rt = Runtime(autocommit_duration_ms=5)
+    rt.register_connector(_TickDriver(n_ticks))
+    t0 = time.perf_counter()
+    rt.run([ln])
+    dt = time.perf_counter() - t0
+    return dt, dict(holder["n"].current)
+
+
+def _last_committed_metric(
+    metric: str, exclude: str | None = None, tail_fallback: bool = False
+):
+    """(value, filename) of ``metric`` in the newest committed BENCH_r*.json
+    carrying it, or None. ``exclude`` skips the file the current run is
+    about to overwrite; ``tail_fallback`` also greps r05-era files that
+    wrapped their metrics inside a log tail string."""
     root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     best: tuple[int, float, str] | None = None
     for path in glob.glob(os.path.join(root, "BENCH_r*.json")):
@@ -102,25 +252,118 @@ def _last_committed_pct(exclude: str | None = None) -> tuple[float, str] | None:
         if not m:
             continue
         if exclude and os.path.abspath(path) == os.path.abspath(exclude):
-            continue  # the file this run is about to overwrite is not a baseline
+            continue
         try:
             blob = json.loads(open(path).read())
         except (OSError, ValueError):
             continue
-        text = blob if isinstance(blob, dict) else {}
-        pct = text.get("engine_incremental_pct_of_static")
-        if pct is None and "tail" in text:
-            # r05-era files wrap the metrics inside a log tail string
-            mm = re.search(r'"engine_incremental_pct_of_static":\s*([0-9.]+)', text["tail"])
-            pct = float(mm.group(1)) if mm else None
-        if pct is None:
+        if not isinstance(blob, dict):
+            continue
+        val = blob.get(metric)
+        if val is None and tail_fallback and "tail" in blob:
+            mm = re.search(rf'"{metric}":\s*([0-9.]+)', blob["tail"])
+            val = float(mm.group(1)) if mm else None
+        if val is None:
             continue
         rev = int(m.group(1))
         if best is None or rev > best[0]:
-            best = (rev, float(pct), os.path.basename(path))
+            best = (rev, float(val), os.path.basename(path))
     if best is None:
         return None
     return best[1], best[2]
+
+
+def small_ticks(
+    rows_per_tick=(64, 256, 1024),
+    n_ticks: int = SMALL_TICKS,
+    reps: int = REPS,
+    out_path: str | None = None,
+) -> dict:
+    """Fused-vs-unfused A/B at small tick sizes, interleaved best-of-reps,
+    byte-identity asserted in-bench; plus the quiescent (empty) tick rate."""
+    results: dict = {"bench": "engine_small_ticks", "n_ticks": n_ticks, "reps": reps}
+    all_rates: dict[tuple, list[float]] = {}
+    for rpt in rows_per_tick:
+        best = {"on": 9e9, "off": 9e9}
+        outs: dict[str, dict] = {}
+        for _ in range(reps):
+            for mode in ("on", "off"):
+                os.environ["PATHWAY_FUSE"] = mode
+                try:
+                    dt, out = _small_tick_run(rpt, n_ticks)
+                finally:
+                    os.environ.pop("PATHWAY_FUSE", None)
+                best[mode] = min(best[mode], dt)
+                outs[mode] = out
+                all_rates.setdefault((rpt, mode), []).append(n_ticks / dt)
+        identical = outs["on"] == outs["off"]
+        if not identical:
+            results["gate_ok"] = False
+            print(json.dumps(results))
+            print(
+                f"GATE FAILURE: fused output differs from unfused at {rpt}-row ticks",
+                file=sys.stderr,
+            )
+            sys.exit(1)
+        speedup = round(best["off"] / best["on"], 2)
+        results[f"small_tick_fused_ticks_per_s_{rpt}"] = round(n_ticks / best["on"], 1)
+        results[f"small_tick_unfused_ticks_per_s_{rpt}"] = round(
+            n_ticks / best["off"], 1
+        )
+        results[f"small_tick_speedup_{rpt}"] = speedup
+    # quiescent ticks: nothing arrives — the r15 sweep short-circuit vs the
+    # r14 full per-node scan + all-node frontier walk
+    for mode in ("on", "off"):
+        os.environ["PATHWAY_FUSE"] = mode
+        try:
+            best_q = min(_small_tick_run(0, 2000)[0] for _ in range(3))
+        finally:
+            os.environ.pop("PATHWAY_FUSE", None)
+        results[f"quiescent_ticks_per_s_{mode}"] = round(2000 / best_q, 1)
+    results["quiescent_speedup"] = round(
+        results["quiescent_ticks_per_s_on"] / results["quiescent_ticks_per_s_off"], 2
+    )
+
+    spread = max(
+        max(v) / max(min(v), 1e-9) for v in all_rates.values() if v
+    )
+    noisy = spread > 1.6
+    results["rep_spread_max"] = round(spread, 2)
+    results["noisy_host"] = noisy
+    results["outputs_byte_identical"] = True
+
+    gate_ok = True
+    prev = _last_committed_metric("small_tick_speedup_64", exclude=out_path)
+    if prev is not None:
+        prev_v, prev_file = prev
+        results["gate_baseline_speedup_64"] = prev_v
+        results["gate_baseline_file"] = prev_file
+        if results["small_tick_speedup_64"] < prev_v - GATE_SPEEDUP_DROP:
+            gate_ok = False
+            msg = (
+                f"small_tick_speedup_64 regressed: "
+                f"{results['small_tick_speedup_64']} vs {prev_v} in {prev_file}"
+            )
+            if os.environ.get("BENCH_MODE") == "1" and not noisy:
+                results["gate_ok"] = False
+                print(json.dumps(results))
+                print(f"GATE FAILURE: {msg}", file=sys.stderr)
+                sys.exit(1)
+            print(f"WARNING: {msg}", file=sys.stderr)
+    results["gate_ok"] = gate_ok
+    return results
+
+
+def _last_committed_pct(exclude: str | None = None) -> tuple[float, str] | None:
+    """Newest committed BENCH_r*.json carrying the pct metric (delegates to
+    the generic metric scan; keeps the r05-era fallback where the metrics
+    were wrapped inside a log tail string)."""
+    found = _last_committed_metric(
+        "engine_incremental_pct_of_static",
+        exclude=exclude,
+        tail_fallback=True,
+    )
+    return found
 
 
 def full(
@@ -163,6 +406,16 @@ def full(
 
     static_s, incr_s = best[1], best[n_times]
     pct = round(100.0 * static_s / incr_s, 1)
+    # the tick-granularity crossover point (r15): over 5 ticks instead of
+    # 20, the run pays 4x fewer rounds of per-tick aggregate corrections
+    # (each touched group re-emits retract+insert once per tick it is
+    # touched in) and 4x fewer per-tick fixed costs, while the bigger
+    # blocks amortize the numpy fixed costs better than one 300k-row
+    # monolith sorts — so incremental BEATS the one-shot load, the paper's
+    # promise. (The headline pct above stays at the historical n/20 point
+    # for BENCH comparability.)
+    coarse = min(run(n, 5)["seconds"] for _ in range(3))
+    pct_coarse = round(100.0 * static_s / coarse, 1)
     results: dict = {
         "bench": "engine_incremental",
         "n": n,
@@ -173,6 +426,7 @@ def full(
         "engine_incremental_rows_per_s": round(n / incr_s, 1),
         "engine_incremental_rows_per_s_all": allruns[n_times],
         "engine_incremental_pct_of_static": pct,
+        "engine_incremental_pct_of_static_coarse_ticks": pct_coarse,
         "outputs_byte_identical": identical,
         "phase_breakdown_ms": {k: v["ms"] for k, v in phases.items()},
         "phase_breakdown_per_tick_ms": {
@@ -227,7 +481,15 @@ if __name__ == "__main__":
         i = args.index("--out")
         out_path = args[i + 1]
         del args[i : i + 2]
-    if args and args[0] == "--full":
+    if args and args[0] == "--small-ticks":
+        sizes = tuple(int(a) for a in args[1:]) or (64, 256, 1024)
+        res = small_ticks(sizes, out_path=out_path)
+        line = json.dumps(res)
+        print(line)
+        if out_path:
+            with open(out_path, "w") as f:
+                f.write(line + "\n")
+    elif args and args[0] == "--full":
         n = int(args[1]) if len(args) > 1 else 300_000
         res = full(n, out_path=out_path)
         line = json.dumps(res)

@@ -228,19 +228,25 @@ def concat_batches(batches: list[DeltaBatch]) -> DeltaBatch | None:
     return DeltaBatch(keys, diffs, data, time)
 
 
-def consolidate(batch: DeltaBatch) -> DeltaBatch:
+def consolidate(batch: DeltaBatch, unique_hint: bool = False) -> DeltaBatch:
     """Sum diffs per (key, row-digest); drop rows with net diff 0.
 
     The block analogue of differential's arrangement consolidation. Canonical
     output order: sorted by key, then net diff ascending (retractions precede
     insertions), then row digest — deterministic for any input permutation.
+
+    ``unique_hint=True``: the caller expects the batch's keys to be unique
+    (e.g. an incremental join's per-tick output, keyed by (left, right) row
+    pairs) — attempt the digest-free unique-key fast path even for
+    mixed-sign batches. Purely a cost hint; a wrong hint costs one wasted
+    argsort and falls through to the general path.
     """
     if len(batch) <= 1:
         if len(batch) == 1 and batch.diffs[0] == 0:
             return batch.take(np.empty(0, dtype=np.int64))
         return batch
     tok = _phases.start()
-    out = _consolidate_impl(batch)
+    out = _consolidate_impl(batch, unique_hint)
     _phases.stop(tok, "consolidate")
     aud = _audit_current()
     if aud is not None:
@@ -250,16 +256,27 @@ def consolidate(batch: DeltaBatch) -> DeltaBatch:
     return out
 
 
-def _consolidate_impl(batch: DeltaBatch) -> DeltaBatch:
-    # fast path — the shape every freshly-polled input block has: all inserts,
-    # no duplicate keys. Nothing can net or merge, so the canonical form is
-    # just a key sort; the per-column row-digest hash (the dominant cost of
-    # the general path) is skipped entirely.
-    if bool((batch.diffs > 0).all()):
+def _consolidate_impl(batch: DeltaBatch, unique_hint: bool = False) -> DeltaBatch:
+    # fast path — unique keys. Netting and merging happen per (key, digest),
+    # so a batch with no duplicate KEY cannot net or merge at all: the
+    # canonical form is just the key sort (within-key diff/digest ordering
+    # is vacuous for singleton groups) with zero diffs dropped, and the
+    # per-column row-digest hash — the dominant cost of the general path —
+    # is skipped entirely. Attempted when the batch is all-inserts (every
+    # freshly-polled input block) or the caller hinted uniqueness (an
+    # incremental join's per-tick output: unique (left, right)-pair keys,
+    # mixed signs under churn — r15: its digest hash was ~1ms of every
+    # churn tick). Duplicate-key batches without the hint (groupby
+    # retract+insert emissions) skip straight to the general path, paying
+    # no speculative sort.
+    if unique_hint or bool((batch.diffs > 0).all()):
         order = np.argsort(batch.keys, kind="stable")
         k = batch.keys[order]
         if not bool((k[1:] == k[:-1]).any()):
-            return batch.take(order)
+            if bool((batch.diffs != 0).all()):
+                return batch.take(order)
+            kept = order[batch.diffs[order] != 0]
+            return batch.take(kept)
     digests = batch.row_digest()
     order = np.lexsort((digests, batch.keys))
     k = batch.keys[order]

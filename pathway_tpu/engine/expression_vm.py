@@ -498,6 +498,252 @@ def _eval_get(expr: GetExpression, ctx: EvalContext) -> np.ndarray:
     return out
 
 
+# ---------------------------------------------------------------- fused tracing
+#
+# The chain-fusion pass (``engine/fusion.py``) lowers runs of filter/map
+# expressions into ONE jitted tick kernel. Only a whitelisted subset lowers:
+# every op must be bit-identical between the numpy path above and XLA
+# (elementwise IEEE float ops, exact integer ops, comparisons) and must have
+# NO value-dependent fallback (integer division routes to the object path on
+# a zero divisor, so it can never fuse). ``infer_fused_dtype`` is the static
+# eligibility check — it mirrors the dtype flow of ``eval_expr`` and returns
+# None the moment an expression leaves the whitelist; ``trace_fused`` is the
+# jax-traceable mirror of ``eval_expr`` for exactly that subset.
+
+#: binops that lower: elementwise, value-independent, bit-identical on XLA
+_FUSE_CMP = {"==", "!=", "<", "<=", ">", ">="}
+_FUSE_ARITH = {"+", "-", "*"}
+_FUSE_BITS = {"&", "|", "^"}
+
+
+def infer_fused_dtype(
+    expr: ColumnExpression, dtypes: dict[str, np.dtype]
+) -> np.dtype | None:
+    """The numpy dtype ``expr`` evaluates to under the fused-kernel
+    whitelist given input column dtypes, or None when it cannot lower."""
+    if isinstance(expr, ColumnReference):
+        if expr.name == "id":
+            return np.dtype(np.uint64)
+        d = dtypes.get(expr.name)
+        return d if d is not None and d.kind in "iufb" else None
+
+    if isinstance(expr, ConstExpression):
+        d = dt.dtype_of_value(expr.value).np_dtype
+        return d if d.kind in "ifb" else None
+
+    if isinstance(expr, DeclareTypeExpression):
+        return infer_fused_dtype(expr.expr, dtypes)
+
+    if isinstance(expr, BinOpExpression):
+        a = infer_fused_dtype(expr.left, dtypes)
+        b = infer_fused_dtype(expr.right, dtypes)
+        if a is None or b is None:
+            return None
+        op = expr.op
+        if op in _FUSE_CMP:
+            if a.kind == "b" or b.kind == "b":
+                # bool comparisons only against bool, and only for equality
+                ok = a.kind == "b" and b.kind == "b" and op in ("==", "!=")
+                return np.dtype(bool) if ok else None
+            if {"u", "i"} <= {a.kind, b.kind}:
+                return None  # numpy promotes u64 vs i64 through float64
+            return np.dtype(bool)
+        if op in _FUSE_ARITH:
+            if a.kind not in "if" or b.kind not in "if":
+                return None  # uints / bools take numpy-specific promotions
+            return np.result_type(a, b)
+        if op in _FUSE_BITS:
+            # eval_expr casts a lone bool operand to int64 before the op
+            if a.kind == "b" and b.kind == "b":
+                return np.dtype(bool)
+            aa = np.dtype(np.int64) if a.kind == "b" else a
+            bb = np.dtype(np.int64) if b.kind == "b" else b
+            if aa.kind not in "iu" or bb.kind not in "iu" or aa.kind != bb.kind:
+                return None
+            return np.result_type(aa, bb)
+        return None
+
+    if isinstance(expr, UnOpExpression):
+        a = infer_fused_dtype(expr.operand, dtypes)
+        if a is None:
+            return None
+        if expr.op == "-":
+            return a if a.kind in "if" else None
+        return a if a.kind in "bi" else None  # ~
+
+    if isinstance(expr, (IsNoneExpression, IsNotNoneExpression)):
+        a = infer_fused_dtype(expr.operand, dtypes)
+        return np.dtype(bool) if a is not None else None
+
+    if isinstance(expr, IfElseExpression):
+        c = infer_fused_dtype(expr.if_, dtypes)
+        t = infer_fused_dtype(expr.then, dtypes)
+        e = infer_fused_dtype(expr.else_, dtypes)
+        if c is None or c.kind != "b" or t is None or t != e:
+            return None
+        return t
+
+    return None
+
+
+def compile_fast(
+    expr: ColumnExpression, dtypes: dict[str, np.dtype], slots: dict[str, int]
+) -> Callable:
+    """Compile a whitelisted expression into a flat numpy closure
+    ``fn(regs, keys) -> array | numpy scalar`` over a REGISTER list
+    (``slots`` maps visible column names to register indices) — the
+    byte-identical fast lane of the composed-segment numpy path. Call only
+    after :func:`infer_fused_dtype` accepted the expression under
+    ``dtypes``.
+
+    Values are identical to :func:`eval_expr`: constants become TYPED numpy
+    scalars (numpy treats a typed scalar operand exactly like the full
+    const array ``eval_expr`` materializes), ops are the same ufuncs, the
+    bool→int64 cast of a mixed bitwise op is baked in at compile time. The
+    closure skips the recursion, isinstance dispatch and per-op errstate of
+    the generic VM — callers wrap one ``np.errstate`` around the whole
+    segment instead."""
+    if isinstance(expr, ColumnReference):
+        if expr.name == "id":
+            return lambda regs, keys: keys
+        i = slots[expr.name]
+        return lambda regs, keys: regs[i]
+
+    if isinstance(expr, ConstExpression):
+        npd = dt.dtype_of_value(expr.value).np_dtype
+        const = npd.type(expr.value)
+        return lambda regs, keys: const
+
+    if isinstance(expr, DeclareTypeExpression):
+        return compile_fast(expr.expr, dtypes, slots)
+
+    if isinstance(expr, BinOpExpression):
+        fa = compile_fast(expr.left, dtypes, slots)
+        fb = compile_fast(expr.right, dtypes, slots)
+        op = expr.op
+        if op in _FUSE_BITS:
+            da = infer_fused_dtype(expr.left, dtypes)
+            db = infer_fused_dtype(expr.right, dtypes)
+            if (da.kind == "b") != (db.kind == "b"):
+                # eval_expr casts a lone bool operand to int64 first
+                if da.kind == "b":
+                    fa = _fast_to_i64(fa)
+                else:
+                    fb = _fast_to_i64(fb)
+        fn = _FAST_UFUNCS[op]
+        return lambda regs, keys: fn(fa(regs, keys), fb(regs, keys))
+
+    if isinstance(expr, UnOpExpression):
+        fa = compile_fast(expr.operand, dtypes, slots)
+        fn = np.negative if expr.op == "-" else np.invert
+        return lambda regs, keys: fn(fa(regs, keys))
+
+    if isinstance(expr, IsNotNoneExpression):
+        fa = compile_fast(expr.operand, dtypes, slots)
+        if infer_fused_dtype(expr.operand, dtypes).kind == "f":
+            return lambda regs, keys: ~np.isnan(fa(regs, keys))
+        return lambda regs, keys: np.ones(len(keys), dtype=bool)
+
+    if isinstance(expr, IsNoneExpression):
+        fa = compile_fast(expr.operand, dtypes, slots)
+        if infer_fused_dtype(expr.operand, dtypes).kind == "f":
+            return lambda regs, keys: np.isnan(fa(regs, keys))
+        return lambda regs, keys: np.zeros(len(keys), dtype=bool)
+
+    if isinstance(expr, IfElseExpression):
+        fc = compile_fast(expr.if_, dtypes, slots)
+        ft = compile_fast(expr.then, dtypes, slots)
+        fe = compile_fast(expr.else_, dtypes, slots)
+        return lambda regs, keys: np.where(
+            fc(regs, keys), ft(regs, keys), fe(regs, keys)
+        )
+
+    raise NotImplementedError(
+        f"compile_fast: {type(expr).__name__} is outside the fused whitelist"
+    )
+
+
+def _fast_to_i64(f: Callable) -> Callable:
+    def g(env, keys):
+        v = f(env, keys)
+        return v.astype(np.int64) if isinstance(v, np.ndarray) else np.int64(v)
+
+    return g
+
+
+#: the ufuncs behind _BINOPS_NUM's operators, called directly (operator.gt
+#: on arrays dispatches to the same ufunc; naming them skips a bounce)
+_FAST_UFUNCS = {
+    "+": np.add,
+    "-": np.subtract,
+    "*": np.multiply,
+    "==": np.equal,
+    "!=": np.not_equal,
+    "<": np.less,
+    "<=": np.less_equal,
+    ">": np.greater,
+    ">=": np.greater_equal,
+    "&": np.bitwise_and,
+    "|": np.bitwise_or,
+    "^": np.bitwise_xor,
+}
+
+
+def trace_fused(expr: ColumnExpression, env: dict[str, Any], keys: Any) -> Any:
+    """jax-traceable mirror of :func:`eval_expr` for the fused whitelist.
+    ``env`` maps column names to traced arrays; ``keys`` is the traced key
+    column (``id`` references). Must be called only after
+    :func:`infer_fused_dtype` accepted the expression."""
+    import jax.numpy as jnp
+
+    if isinstance(expr, ColumnReference):
+        return keys if expr.name == "id" else env[expr.name]
+
+    if isinstance(expr, ConstExpression):
+        npd = dt.dtype_of_value(expr.value).np_dtype
+        return jnp.full(keys.shape, expr.value, dtype=npd)
+
+    if isinstance(expr, DeclareTypeExpression):
+        return trace_fused(expr.expr, env, keys)
+
+    if isinstance(expr, BinOpExpression):
+        a = trace_fused(expr.left, env, keys)
+        b = trace_fused(expr.right, env, keys)
+        op = expr.op
+        if op in _FUSE_BITS and (a.dtype.kind == "b") != (b.dtype.kind == "b"):
+            a = a.astype(jnp.int64) if a.dtype.kind == "b" else a
+            b = b.astype(jnp.int64) if b.dtype.kind == "b" else b
+        return _BINOPS_NUM[op](a, b)
+
+    if isinstance(expr, UnOpExpression):
+        a = trace_fused(expr.operand, env, keys)
+        if expr.op == "-":
+            return -a
+        return ~a
+
+    if isinstance(expr, IsNotNoneExpression):
+        a = trace_fused(expr.operand, env, keys)
+        if a.dtype.kind == "f":
+            return ~jnp.isnan(a)
+        return jnp.ones(a.shape, dtype=bool)
+
+    if isinstance(expr, IsNoneExpression):
+        a = trace_fused(expr.operand, env, keys)
+        if a.dtype.kind == "f":
+            return jnp.isnan(a)
+        return jnp.zeros(a.shape, dtype=bool)
+
+    if isinstance(expr, IfElseExpression):
+        c = trace_fused(expr.if_, env, keys)
+        t = trace_fused(expr.then, env, keys)
+        e = trace_fused(expr.else_, env, keys)
+        return jnp.where(c, t, e)
+
+    raise NotImplementedError(
+        f"trace_fused: {type(expr).__name__} is outside the fused whitelist"
+    )
+
+
 def compile_rowwise(
     exprs: dict[str, ColumnExpression],
     lookup_factory: Callable[["Any"], Callable[[ColumnReference], np.ndarray]],

@@ -12,6 +12,7 @@ inputs" consistency model.
 
 from __future__ import annotations
 
+import heapq
 import time as _time
 from typing import Callable
 
@@ -21,6 +22,7 @@ from pathway_tpu.engine.blocks import DeltaBatch, concat_batches
 from pathway_tpu.internals.trace import run_annotated as _run_annotated
 from pathway_tpu.observability import audit as _audit
 from pathway_tpu.observability import device as _device_prof
+from pathway_tpu.observability import engine_phases as _phases
 from pathway_tpu.resilience import faults as _faults
 
 END_OF_STREAM = np.iinfo(np.int64).max  # frontier value after all input closed
@@ -159,9 +161,16 @@ class EngineGraph:
 
 
 class Scheduler:
-    """Drives the engine graph tick by tick."""
+    """Drives the engine graph tick by tick.
 
-    def __init__(self, graph: EngineGraph):
+    r15: the sweep is PLAN-driven (``engine/fusion.py``). Fused chains
+    execute as single steps, idle nodes are never visited — routing marks
+    the consumer's step dirty, and a sweep drains the dirty set in
+    topological order (edges only point forward, so one drain reaches
+    quiescence). The tick's poll/frontier/complete loops visit only nodes
+    that actually override those hooks."""
+
+    def __init__(self, graph: EngineGraph, transient: bool = False):
         self.graph = graph
         self.current_time = 0
         self.on_tick_done: list[Callable[[int], None]] = []
@@ -169,26 +178,47 @@ class Scheduler:
         # the hot loops below pay exactly one is-not-None test per guard
         self.tracer = None
         self._trace_active = False
+        from pathway_tpu.engine import fusion as _fusion
+
+        # transient = a short-lived inner graph rebuilt per use (iterate's
+        # fixed-point runner): chain fusion still applies, but the jitted
+        # segment tier is disabled — a fresh jax.jit per rebuild would
+        # re-trace its kernel every tick
+        self.plan = _fusion.build_plan(graph, exchange_aware=False, transient=transient)
+        # dirty step positions; during a sweep, forward marks go straight
+        # onto the active heap (all edges point forward, so a marked step is
+        # always still ahead of the cursor)
+        self._dirty: set[int] = set()
+        self._heap: list[int] | None = None
+
+    def _mark(self, pos: int) -> None:
+        h = self._heap
+        if h is not None:
+            heapq.heappush(h, pos)
+        else:
+            self._dirty.add(pos)
 
     def _route(self, producer: Node, batches: list[DeltaBatch]) -> bool:
         routed = False
         consumers = self.graph.edges.get(producer.node_index, [])
+        plan = self.plan
         for batch in batches:
             if batch is None or batch.is_empty:
                 continue
             producer.stats_rows_out += len(batch)
             for ci, port in consumers:
                 self.graph.nodes[ci].accept(port, batch)
+                if plan is not None:
+                    self._mark(plan.pos_of[ci])
                 routed = True
         return routed
 
-    def _sweep(self, time: int) -> bool:
-        """One topo pass; returns True if any node did work."""
+    def _sweep_legacy(self, time: int) -> bool:
+        """The r14 sweep, verbatim: one full topo scan, one node per step.
+        Active under ``PATHWAY_FUSE=off`` (plan is None)."""
         any_work = False
         trace = self._trace_active
         aud = _audit.current()
-        # edge cardinality recording rides the audit plane's deterministic
-        # tick sample — unsampled ticks pay only this flag read
         aud_note = aud is not None and aud.edge_sampled
         for node in self.graph.nodes:
             if not node.has_pending():
@@ -198,8 +228,6 @@ class Scheduler:
             node.stats_rows_in += rows_in
             if trace:
                 w0 = _time.time_ns()
-                # host/device split: traced dispatches inside this node
-                # accumulate their block_until_ready wait on sampled ticks
                 dev0 = _device_prof.thread_device_wait_ns()
             t0 = _time.perf_counter_ns()
             out = _run_annotated(node, node.process, inputs, time)
@@ -223,11 +251,124 @@ class Scheduler:
                         f"sweep/{node.name}", max(0, elapsed_ns - dev_ns), dev_ns
                     )
             if aud_note:
-                # audit plane: per-edge cardinality/selectivity counters
                 aud.note_edge(node, inputs, out)
             self._route(node, out)
             any_work = True
         return any_work
+
+    def _sweep(self, time: int) -> bool:
+        """Drain the dirty steps in topo order; returns True if any step did
+        work. Quiescence check is O(1): an empty dirty set."""
+        if self.plan is None:
+            return self._sweep_legacy(time)
+        dirty = self._dirty
+        if not dirty:
+            return False
+        heap = sorted(dirty)
+        dirty.clear()
+        self._heap = heap
+        any_work = False
+        trace = self._trace_active
+        aud = _audit.current()
+        # edge cardinality recording rides the audit plane's deterministic
+        # tick sample — unsampled ticks pay only this flag read
+        aud_note = aud is not None and aud.edge_sampled
+        by_pos = self.plan.by_pos
+        last = -1
+        try:
+            while heap:
+                pos = heapq.heappop(heap)
+                if pos == last:
+                    continue  # duplicate marks collapse (ascending pops)
+                last = pos
+                step = by_pos[pos]
+                chain = step.chain
+                if chain is not None:
+                    if self._run_chain(chain, time, trace, aud if aud_note else None):
+                        any_work = True
+                    continue
+                node = step.node
+                if not node.has_pending():
+                    continue
+                inputs = node.drain()
+                rows_in = sum(len(b) for b in inputs if b is not None)
+                node.stats_rows_in += rows_in
+                if trace:
+                    w0 = _time.time_ns()
+                    # host/device split: traced dispatches inside this node
+                    # accumulate their block_until_ready wait on sampled ticks
+                    dev0 = _device_prof.thread_device_wait_ns()
+                t0 = _time.perf_counter_ns()
+                out = _run_annotated(node, node.process, inputs, time)
+                elapsed_ns = _time.perf_counter_ns() - t0
+                node.stats_time_ns += elapsed_ns
+                if trace:
+                    dev_ns = _device_prof.thread_device_wait_ns() - dev0
+                    self.tracer.span(
+                        f"sweep/{node.name}",
+                        w0,
+                        _time.time_ns(),
+                        {
+                            "pathway.operator.id": node.node_index,
+                            "pathway.rows_in": rows_in,
+                            "pathway.rows_out": sum(
+                                len(b) for b in out if b is not None
+                            ),
+                            "pathway.device_ms": round(dev_ns / 1e6, 3),
+                        },
+                    )
+                    if dev_ns:
+                        _device_prof.stats().note_span_split(
+                            f"sweep/{node.name}", max(0, elapsed_ns - dev_ns), dev_ns
+                        )
+                if aud_note:
+                    # audit plane: per-edge cardinality/selectivity counters
+                    aud.note_edge(node, inputs, out)
+                self._route(node, out)
+                any_work = True
+        finally:
+            self._heap = None
+        return any_work
+
+    def _run_chain(self, chain, time: int, trace: bool, aud) -> bool:
+        """One fused-chain step: drain, hand off member to member, route the
+        tail. Span + host/device attribution is per CHAIN — the device wait
+        AND any inner traced-jit cold (compile) wall are subtracted from the
+        host share so compile seconds stay counted once (r10 discipline)."""
+        if trace:
+            w0 = _time.time_ns()
+            dev0 = _device_prof.thread_device_wait_ns()
+            cold0 = _device_prof.thread_cold_s()
+        t0 = _time.perf_counter_ns()
+        tok = _phases.start()
+        try:
+            out, processed, rows_in, rows_out = chain.execute(time, None, aud)
+        finally:
+            _phases.stop(tok, "fused")
+        if not processed:
+            return False
+        elapsed_ns = _time.perf_counter_ns() - t0
+        chain.tail.stats_time_ns += elapsed_ns
+        if trace:
+            dev_ns = _device_prof.thread_device_wait_ns() - dev0
+            cold_ns = int((_device_prof.thread_cold_s() - cold0) * 1e9)
+            name = f"sweep/chain{{{chain.label}}}"
+            attrs = {
+                "pathway.operator.id": chain.operator_ids(),
+                "pathway.chain.nodes": len(chain.members),
+                "pathway.rows_in": rows_in,
+                "pathway.rows_out": rows_out,
+                "pathway.device_ms": round(dev_ns / 1e6, 3),
+            }
+            if cold_ns:
+                attrs["pathway.compile_ms"] = round(cold_ns / 1e6, 3)
+            self.tracer.span(name, w0, _time.time_ns(), attrs)
+            if dev_ns:
+                _device_prof.stats().note_span_split(
+                    name, max(0, elapsed_ns - dev_ns - cold_ns), dev_ns
+                )
+        self._route(chain.tail, out)
+        return True
 
     def run_tick(self, time: int) -> None:
         """Process everything pending at logical ``time`` to quiescence, then
@@ -242,7 +383,9 @@ class Scheduler:
         aud = _audit.current()
         if aud is not None:
             aud.begin_tick(time)
-        for node in self.graph.nodes:
+        plan = self.plan
+        pollers = self.graph.nodes if plan is None else plan.pollers
+        for node in pollers:
             polled = _run_annotated(node, node.poll, time)
             if polled:
                 # fault plan (flip_diff/drop_retract) corrupts BEFORE the
@@ -254,18 +397,21 @@ class Scheduler:
             self._route(node, polled)
         while self._sweep(time):
             pass
-        # frontier phase: notify in topo order; emissions re-enter the same tick
+        # frontier phase: notify in topo order; emissions re-enter the same
+        # tick (only nodes that override on_frontier are visited)
+        frontier = self.graph.nodes if plan is None else plan.frontier_nodes
         progressed = True
         while progressed:
             progressed = False
-            for node in self.graph.nodes:
+            for node in frontier:
                 out = _run_annotated(node, node.on_frontier, time)
                 if self._route(node, out):
                     progressed = True
             if progressed:
                 while self._sweep(time):
                     pass
-        for node in self.graph.nodes:
+        complete = self.graph.nodes if plan is None else plan.tick_complete_nodes
+        for node in complete:
             _run_annotated(node, node.on_tick_complete, time)
         for cb in self.on_tick_done:
             cb(time)

@@ -394,10 +394,17 @@ class RowwiseNode(Node):
         self,
         program: Callable[[DeltaBatch], dict[str, np.ndarray]],
         expensive: bool = False,
+        exprs: dict | None = None,
     ):
         super().__init__(n_inputs=1)
         self.program = program
         self.expensive = expensive
+        #: the named expression ASTs ``program`` was compiled from, when the
+        #: builder has them — lets the chain-fusion pass compose consecutive
+        #: rowwise stages into one block program / jitted kernel
+        #: (``engine/fusion.py``); None keeps the node opaque (closure-only
+        #: programs, e.g. iterate internals)
+        self.exprs = exprs
 
     def process(self, inputs, time):
         batch = inputs[0]
@@ -412,9 +419,13 @@ class FilterNode(Node):
     def exchange_key(self, port):
         return None  # stateless: process where produced
 
-    def __init__(self, predicate: Callable[[DeltaBatch], np.ndarray]):
+    def __init__(
+        self, predicate: Callable[[DeltaBatch], np.ndarray], expr: Any = None
+    ):
         super().__init__(n_inputs=1)
         self.predicate = predicate
+        #: predicate AST for the chain-fusion pass (see RowwiseNode.exprs)
+        self.expr = expr
 
     def process(self, inputs, time):
         batch = inputs[0]
@@ -1974,7 +1985,10 @@ class JoinNode(Node):
         merged = concat_batches(out)
         if merged is None:
             return []
-        return [consolidate(merged)]
+        # unique_hint: a tick's matched output keys are (left, right)-pair
+        # hashes, distinct within the tick except same-tick upserts — the
+        # digest-free canonicalization almost always applies
+        return [consolidate(merged, unique_hint=True)]
 
 
 # ---------------------------------------------------------------------------- outputs

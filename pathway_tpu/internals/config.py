@@ -159,6 +159,45 @@ class PathwayConfig:
         return _env_bool("PATHWAY_ENGINE_PHASES", False)
 
     @property
+    def fuse(self) -> str:
+        """Chain fusion (``engine/fusion.py``): lower maximal
+        single-consumer operator chains into one sweep step per chain —
+        batches hand off member to member in-process instead of paying the
+        per-node drain/route/accept dispatch, and runs of expression members
+        collapse into one composed block program. ``off`` restores the
+        one-node-per-step r14 sweep byte-for-byte. Default ``on``
+        (BENCH_r15: the small-tick dispatch win)."""
+        mode = os.environ.get("PATHWAY_FUSE", "on").strip().lower()
+        if mode in ("on", "1", "true"):
+            return "on"
+        if mode in ("off", "0", "false"):
+            return "off"
+        raise ValueError(f"PATHWAY_FUSE must be off/on, got {mode!r}")
+
+    @property
+    def fuse_jax(self) -> str:
+        """Jitted fused-chain kernels: lower a composed expression segment
+        (whitelisted numeric filter/map chain) into ONE buffer-donating XLA
+        launch per tick, inputs padded to the shared power-of-two buckets so
+        the jit shape set stays closed under row-count churn. ``auto``
+        routes only blocks of at least ``PATHWAY_FUSE_JAX_MIN_ROWS`` rows
+        (below that, XLA dispatch overhead loses to the composed numpy
+        program on CPU — the jax_kernels adoption discipline); ``on``
+        forces every eligible block through the kernel; ``off`` keeps chains
+        on the composed numpy path. Values are bit-identical either way
+        (the whitelist admits only ops with no numpy/XLA divergence)."""
+        mode = os.environ.get("PATHWAY_FUSE_JAX", "auto").strip().lower()
+        if mode not in ("off", "auto", "on"):
+            raise ValueError(f"PATHWAY_FUSE_JAX must be off/auto/on, got {mode!r}")
+        return mode
+
+    @property
+    def fuse_jax_min_rows(self) -> int:
+        """Row threshold for ``PATHWAY_FUSE_JAX=auto`` (default 65536 —
+        the measured crossover scale of the other engine kernels on CPU)."""
+        return max(1, _env_int("PATHWAY_FUSE_JAX_MIN_ROWS", 65536))
+
+    @property
     def arrange_device_cache(self) -> bool:
         """Persistent device-resident arrangements for the jitted probe
         kernel: sorted state segments are transferred once per compaction
@@ -593,6 +632,9 @@ class PathwayConfig:
                 "device_exchange_fused",
                 "arrange_device_cache",
                 "arrange_donate",
+                "fuse",
+                "fuse_jax",
+                "fuse_jax_min_rows",
             )
         }
 

@@ -193,7 +193,9 @@ class IterateRunnerNode(Node):
             ctx.graph.add_node(cap, [reorder])
             caps[name] = cap
         ctx.finish()
-        sched = Scheduler(ctx.graph)
+        # transient: this inner graph is rebuilt per fixed-point run, so the
+        # fused segments must not take the jitted tier (per-rebuild re-trace)
+        sched = Scheduler(ctx.graph, transient=True)
 
         fed = {n: dict(self.input_state[n]) for n in self.in_names}
         for n in self.in_names:

@@ -143,7 +143,7 @@ class Table:
             program = _compile_program(exprs, self)
             expensive = any(_has_apply(e) for e in exprs.values())
             node = LogicalNode(
-                lambda: ops.RowwiseNode(program, expensive=expensive),
+                lambda: ops.RowwiseNode(program, expensive=expensive, exprs=exprs),
                 [self._node],
                 name="select",
             )
@@ -200,7 +200,9 @@ class Table:
     def filter(self, filter_expression: Any) -> "Table":
         bound = self._bind(filter_expression)
         predicate = _compile_single(bound, self)
-        node = LogicalNode(lambda: ops.FilterNode(predicate), [self._node], name="filter")
+        node = LogicalNode(
+            lambda: ops.FilterNode(predicate, expr=bound), [self._node], name="filter"
+        )
         return Table(node, self._schema, self._universe.subset())
 
     def split(self, split_expression: Any) -> tuple["Table", "Table"]:

@@ -37,14 +37,18 @@ from typing import Any
 import numpy as np
 
 from pathway_tpu.engine.blocks import DeltaBatch
+from pathway_tpu.engine import fusion as _fusion
 from pathway_tpu.engine.graph import BROADCAST, END_OF_STREAM, SOLO, Node
 from pathway_tpu.internals.config import get_pathway_config
 from pathway_tpu.internals.errors import OtherWorkerError
 from pathway_tpu.internals.logical import BuildContext, LogicalNode
 from pathway_tpu.internals.trace import run_annotated
 from pathway_tpu.observability import audit as _audit
+from pathway_tpu.observability import engine_phases as _phases
 from pathway_tpu.parallel.mesh import shard_of_keys
 from pathway_tpu.resilience import faults as _faults
+
+import heapq
 
 
 def cluster_env() -> tuple[int, int, int, int]:
@@ -413,6 +417,18 @@ class _LocalWorker:
         self.index = global_index
         self.graph = graph
         self.lock = threading.Lock()
+        # fused-chain sweep plan (exchange-aware: see parallel/sharded.py)
+        self.plan = _fusion.build_plan(graph, exchange_aware=True)
+        #: dirty step positions, guarded by ``lock`` (marks arrive from peer
+        #: link reader threads and sibling worker threads)
+        self.dirty: set[int] = set()
+        #: the active sweep's forward-insertion heap (own thread only)
+        self.sweep_heap: list[int] | None = None
+
+    def mark_dirty_locked(self, node_index: int) -> None:
+        # no-op in legacy (PATHWAY_FUSE=off) mode: the full scan finds work
+        if self.plan is not None:
+            self.dirty.add(self.plan.pos_of[node_index])
 
 
 class ClusterRuntime:
@@ -521,6 +537,7 @@ class ClusterRuntime:
         lw = self.local_workers[worker]
         with lw.lock:
             lw.graph.nodes[node_index].accept(port, batch)
+            lw.mark_dirty_locked(node_index)
 
     def _deliver(self, worker: int, node_index: int, port: int, batch: DeltaBatch) -> None:
         owner = self.owner_of(worker)
@@ -528,8 +545,22 @@ class ClusterRuntime:
             lw = self.local_workers[worker]
             with lw.lock:
                 lw.graph.nodes[node_index].accept(port, batch)
+                lw.mark_dirty_locked(node_index)
         else:
             self.links.send_block(owner, worker, node_index, port, batch)
+
+    def _accept_local(self, lw: _LocalWorker, ci: int, port: int, batch) -> None:
+        """Same-worker accept from the worker's own thread (see
+        parallel/sharded.py: a mid-sweep mark rides the active heap)."""
+        lw.graph.nodes[ci].accept(port, batch)
+        if lw.plan is None:
+            return  # legacy mode: the full scan finds it
+        h = lw.sweep_heap
+        if h is not None:
+            heapq.heappush(h, lw.plan.pos_of[ci])
+        else:
+            with lw.lock:
+                lw.mark_dirty_locked(ci)
 
     def _route(self, lw: _LocalWorker, producer: Node, batches: list[DeltaBatch]) -> bool:
         routed = False
@@ -542,7 +573,7 @@ class ClusterRuntime:
                 consumer = lw.graph.nodes[ci]
                 key_fn = consumer.exchange_key(port)
                 if key_fn is None:
-                    consumer.accept(port, batch)
+                    self._accept_local(lw, ci, port, batch)
                 elif key_fn == SOLO:
                     self._deliver(0, ci, port, batch)
                 elif key_fn == BROADCAST:
@@ -567,7 +598,8 @@ class ClusterRuntime:
         return routed
 
     # ---------------------------------------------------------------- ticking
-    def _sweep_worker(self, lw: _LocalWorker, time: int) -> bool:
+    def _sweep_worker_legacy(self, lw: _LocalWorker, time: int) -> bool:
+        """The r14 per-worker sweep, verbatim (PATHWAY_FUSE=off)."""
         any_work = False
         trace = self._trace_active
         aud = _audit.current()
@@ -608,6 +640,114 @@ class ClusterRuntime:
             self._route(lw, node, out)
             any_work = True
         return any_work
+
+    def _sweep_worker(self, lw: _LocalWorker, time: int) -> bool:
+        if lw.plan is None:
+            return self._sweep_worker_legacy(lw, time)
+        with lw.lock:
+            if not lw.dirty:
+                return False
+            heap = sorted(lw.dirty)
+            lw.dirty.clear()
+        lw.sweep_heap = heap
+        any_work = False
+        trace = self._trace_active
+        aud = _audit.current()
+        aud_note = aud is not None and aud.edge_sampled
+        by_pos = lw.plan.by_pos
+        last = -1
+        try:
+            while heap:
+                pos = heapq.heappop(heap)
+                if pos == last:
+                    continue
+                last = pos
+                step = by_pos[pos]
+                chain = step.chain
+                if chain is not None:
+                    if self._run_chain(lw, chain, time, trace, aud if aud_note else None):
+                        any_work = True
+                    continue
+                node = step.node
+                with lw.lock:
+                    if not node.has_pending():
+                        continue
+                    inputs = node.drain()
+                rows_in = sum(len(b) for b in inputs if b is not None)
+                node.stats_rows_in += rows_in
+                if trace:
+                    from pathway_tpu.observability import device as _dev_prof
+
+                    w0 = _time.time_ns()
+                    dev0 = _dev_prof.thread_device_wait_ns()
+                out = run_annotated(node, node.process, inputs, time)
+                if trace:
+                    w1 = _time.time_ns()
+                    dev_ns = _dev_prof.thread_device_wait_ns() - dev0
+                    self.tracer.span(
+                        f"sweep/{node.name}",
+                        w0,
+                        w1,
+                        {
+                            "pathway.operator.id": node.node_index,
+                            "pathway.worker": lw.index,
+                            "pathway.rows_in": rows_in,
+                            "pathway.device_ms": round(dev_ns / 1e6, 3),
+                        },
+                    )
+                    if dev_ns:
+                        _dev_prof.stats().note_span_split(
+                            f"sweep/{node.name}", max(0, w1 - w0 - dev_ns), dev_ns
+                        )
+                if aud_note:
+                    aud.note_edge(node, inputs, out)
+                self._route(lw, node, out)
+                any_work = True
+        finally:
+            lw.sweep_heap = None
+        return any_work
+
+    def _run_chain(self, lw: _LocalWorker, chain, time: int, trace: bool, aud) -> bool:
+        """One fused-chain step (see Scheduler._run_chain: per-chain span,
+        device wait and traced-jit cold walls subtracted from host share)."""
+        from pathway_tpu.observability import device as _dev_prof
+
+        if trace:
+            w0 = _time.time_ns()
+            dev0 = _dev_prof.thread_device_wait_ns()
+            cold0 = _dev_prof.thread_cold_s()
+        t0 = _time.perf_counter_ns()
+        tok = _phases.start()
+        try:
+            out, processed, rows_in, rows_out = chain.execute(time, lw.lock, aud)
+        finally:
+            _phases.stop(tok, "fused")
+        if not processed:
+            return False
+        elapsed_ns = _time.perf_counter_ns() - t0
+        chain.tail.stats_time_ns += elapsed_ns
+        if trace:
+            w1 = _time.time_ns()
+            dev_ns = _dev_prof.thread_device_wait_ns() - dev0
+            cold_ns = int((_dev_prof.thread_cold_s() - cold0) * 1e9)
+            name = f"sweep/chain{{{chain.label}}}"
+            attrs = {
+                "pathway.operator.id": chain.operator_ids(),
+                "pathway.worker": lw.index,
+                "pathway.chain.nodes": len(chain.members),
+                "pathway.rows_in": rows_in,
+                "pathway.rows_out": rows_out,
+                "pathway.device_ms": round(dev_ns / 1e6, 3),
+            }
+            if cold_ns:
+                attrs["pathway.compile_ms"] = round(cold_ns / 1e6, 3)
+            self.tracer.span(name, w0, w1, attrs)
+            if dev_ns:
+                _dev_prof.stats().note_span_split(
+                    name, max(0, elapsed_ns - dev_ns - cold_ns), dev_ns
+                )
+        self._route(lw, chain.tail, out)
+        return True
 
     def _sweep_all_local(self, time: int) -> bool:
         workers = list(self.local_workers.values())
@@ -667,12 +807,16 @@ class ClusterRuntime:
                 did = True
             sent, received = self.links.counters()
             # pending is read AFTER the counters: a block that lands between
-            # sweep and here is visible either as sent>recv or as pending
-            pending = any(
-                node.has_pending()
-                for lw in self.local_workers.values()
-                for node in lw.graph.nodes
-            )
+            # sweep and here is visible either as sent>recv or as pending.
+            # Pending nodes are re-marked dirty (idempotent) so the plan
+            # sweep can never strand a buffered block.
+            pending = False
+            for lw in self.local_workers.values():
+                for node in lw.graph.nodes:
+                    if node.has_pending():
+                        pending = True
+                        with lw.lock:
+                            lw.mark_dirty_locked(node.node_index)
             report = (phase, did or pending, sent, received)
 
             def decide(reports):
@@ -753,15 +897,20 @@ class ClusterRuntime:
                     aud.observe_input(node, polled, time)
             return polled
 
+        def _nodes(lw, kind):
+            if lw.plan is None:
+                return lw.graph.nodes
+            return getattr(lw.plan, kind)
+
         if not skip_poll and 0 in self.local_workers:
             lw0 = self.local_workers[0]
-            for node in lw0.graph.nodes:
+            for node in _nodes(lw0, "pollers"):
                 self._route(lw0, node, _polled(node))
         if not skip_poll:
             for gi, lw in self.local_workers.items():
                 if gi == 0:
                     continue
-                for node in lw.graph.nodes:
+                for node in _nodes(lw, "pollers"):
                     if getattr(node, "local_source", False):
                         self._route(lw, node, _polled(node))
         self._round_until_quiescent(time, "sweep")
@@ -769,7 +918,7 @@ class ClusterRuntime:
             self._sync_watermarks()
             progressed = False
             for lw in self.local_workers.values():
-                for node in lw.graph.nodes:
+                for node in _nodes(lw, "frontier_nodes"):
                     if self._route(lw, node, run_annotated(node, node.on_frontier, time)):
                         progressed = True
 
@@ -781,7 +930,7 @@ class ClusterRuntime:
                 break
             self._round_until_quiescent(time, "sweep")
         for lw in self.local_workers.values():
-            for node in lw.graph.nodes:
+            for node in _nodes(lw, "tick_complete_nodes"):
                 run_annotated(node, node.on_tick_complete, time)
         for cb in self.on_tick_done:
             cb(time)

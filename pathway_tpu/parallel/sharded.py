@@ -34,16 +34,19 @@ serialized between processes instead of handed between threads).
 
 from __future__ import annotations
 
+import heapq
 import threading
 from typing import Any
 
 import numpy as np
 
+from pathway_tpu.engine import fusion as _fusion
 from pathway_tpu.engine.blocks import DeltaBatch
 from pathway_tpu.engine.graph import BROADCAST, END_OF_STREAM, SOLO, EngineGraph, Node
 from pathway_tpu.internals.logical import BuildContext, LogicalNode
 from pathway_tpu.internals.trace import run_annotated
 from pathway_tpu.observability import audit as _audit
+from pathway_tpu.observability import engine_phases as _phases
 from pathway_tpu.parallel.mesh import shard_of_keys
 from pathway_tpu.resilience import faults as _faults
 
@@ -53,6 +56,22 @@ class _Worker:
         self.index = index
         self.graph = graph
         self.lock = threading.Lock()  # guards cross-worker accepts
+        # fused-chain sweep plan (interior links restricted to exchange-free
+        # consumers: fusing across an exchange would move rows off the worker
+        # the unfused routing would have placed them on)
+        self.plan = _fusion.build_plan(graph, exchange_aware=True)
+        #: dirty step positions (guarded by ``lock`` — marks arrive from any
+        #: worker thread routing into this worker's graph)
+        self.dirty: set[int] = set()
+        #: the active sweep's heap — only this worker's own thread touches it
+        self.sweep_heap: list[int] | None = None
+
+    def mark_dirty_locked(self, node_index: int) -> None:
+        """Mark the step owning ``node_index`` dirty. Caller holds ``lock``.
+        No-op in legacy (PATHWAY_FUSE=off) mode — the full-scan sweep finds
+        pending work by walking every node."""
+        if self.plan is not None:
+            self.dirty.add(self.plan.pos_of[node_index])
 
 
 class ShardedRuntime:
@@ -123,6 +142,21 @@ class ShardedRuntime:
         assert len(sizes) == 1, "worker graphs misaligned"
 
     # ---------------------------------------------------------------- routing
+    def _accept_local(self, worker: _Worker, ci: int, port: int, batch) -> None:
+        """Same-worker accept from the worker's own thread: a mid-sweep mark
+        goes straight onto the active heap (edges only point forward), so
+        the consumer runs in this same sweep — exactly the scan order the
+        full-walk sweep had."""
+        worker.graph.nodes[ci].accept(port, batch)
+        if worker.plan is None:
+            return  # legacy mode: the full scan finds it
+        h = worker.sweep_heap
+        if h is not None:
+            heapq.heappush(h, worker.plan.pos_of[ci])
+        else:
+            with worker.lock:
+                worker.mark_dirty_locked(ci)
+
     def _route(self, worker: _Worker, producer: Node, batches: list[DeltaBatch]) -> bool:
         routed = False
         consumers = worker.graph.edges.get(producer.node_index, [])
@@ -134,23 +168,25 @@ class ShardedRuntime:
                 consumer = worker.graph.nodes[ci]
                 key_fn = consumer.exchange_key(port)
                 if key_fn is None:
-                    consumer.accept(port, batch)
+                    self._accept_local(worker, ci, port, batch)
                     routed = True
                 elif key_fn == SOLO:
                     target = self.workers[0]
                     dest = target.graph.nodes[ci]
                     with target.lock:
                         dest.accept(port, batch)
+                        target.mark_dirty_locked(ci)
                     routed = True
                 elif key_fn == BROADCAST:
                     for target in self.workers:
                         dest = target.graph.nodes[ci]
                         with target.lock:
                             dest.accept(port, batch)
+                            target.mark_dirty_locked(ci)
                     routed = True
                 else:
                     if self.n_workers == 1:
-                        consumer.accept(port, batch)
+                        self._accept_local(worker, ci, port, batch)
                         routed = True
                         continue
                     route_keys = np.asarray(key_fn(batch), dtype=np.uint64)
@@ -172,11 +208,13 @@ class ShardedRuntime:
                         dest = target.graph.nodes[ci]
                         with target.lock:
                             dest.accept(port, piece)
+                            target.mark_dirty_locked(ci)
                         routed = True
         return routed
 
     # ---------------------------------------------------------------- ticking
-    def _sweep_worker(self, worker: _Worker, time: int) -> bool:
+    def _sweep_worker_legacy(self, worker: _Worker, time: int) -> bool:
+        """The r14 per-worker sweep, verbatim (PATHWAY_FUSE=off)."""
         import time as _t
 
         any_work = False
@@ -215,13 +253,129 @@ class ShardedRuntime:
                         f"sweep/{node.name}", max(0, w1 - w0 - dev_ns), dev_ns
                     )
             if aud_note:
-                # per-edge cardinality counters (node instances are per-worker,
-                # so no cross-thread contention; read side sums by position)
                 aud.note_edge(node, inputs, out)
             if self._route(worker, node, out):
                 any_work = True
             any_work = any_work or any(b is not None for b in inputs)
         return any_work
+
+    def _sweep_worker(self, worker: _Worker, time: int) -> bool:
+        import time as _t
+
+        if worker.plan is None:
+            return self._sweep_worker_legacy(worker, time)
+        with worker.lock:
+            if not worker.dirty:
+                return False
+            heap = sorted(worker.dirty)
+            worker.dirty.clear()
+        worker.sweep_heap = heap
+        any_work = False
+        trace = self._trace_active
+        aud = _audit.current()
+        aud_note = aud is not None and aud.edge_sampled
+        by_pos = worker.plan.by_pos
+        last = -1
+        try:
+            while heap:
+                pos = heapq.heappop(heap)
+                if pos == last:
+                    continue
+                last = pos
+                step = by_pos[pos]
+                chain = step.chain
+                if chain is not None:
+                    if self._run_chain(worker, chain, time, trace, aud if aud_note else None):
+                        any_work = True
+                    continue
+                node = step.node
+                with worker.lock:
+                    if not node.has_pending():
+                        continue
+                    inputs = node.drain()
+                rows_in = sum(len(b) for b in inputs if b is not None)
+                node.stats_rows_in += rows_in
+                if trace:
+                    from pathway_tpu.observability import device as _dev_prof
+
+                    w0 = _t.time_ns()
+                    dev0 = _dev_prof.thread_device_wait_ns()
+                out = run_annotated(node, node.process, inputs, time)
+                if trace:
+                    w1 = _t.time_ns()
+                    dev_ns = _dev_prof.thread_device_wait_ns() - dev0
+                    self.tracer.span(
+                        f"sweep/{node.name}",
+                        w0,
+                        w1,
+                        {
+                            "pathway.operator.id": node.node_index,
+                            "pathway.worker": worker.index,
+                            "pathway.rows_in": rows_in,
+                            "pathway.device_ms": round(dev_ns / 1e6, 3),
+                        },
+                    )
+                    if dev_ns:
+                        _dev_prof.stats().note_span_split(
+                            f"sweep/{node.name}", max(0, w1 - w0 - dev_ns), dev_ns
+                        )
+                if aud_note:
+                    # per-edge cardinality counters (node instances are
+                    # per-worker, so no cross-thread contention; read side
+                    # sums by position)
+                    aud.note_edge(node, inputs, out)
+                self._route(worker, node, out)
+                any_work = True
+        finally:
+            worker.sweep_heap = None
+        return any_work
+
+    def _run_chain(self, worker: _Worker, chain, time: int, trace: bool, aud) -> bool:
+        """One fused-chain step on this worker (see Scheduler._run_chain:
+        per-chain span, device wait AND inner traced-jit cold walls
+        subtracted from the host share)."""
+        import time as _t
+
+        from pathway_tpu.observability import device as _dev_prof
+
+        if trace:
+            w0 = _t.time_ns()
+            dev0 = _dev_prof.thread_device_wait_ns()
+            cold0 = _dev_prof.thread_cold_s()
+        t0 = _t.perf_counter_ns()
+        tok = _phases.start()
+        try:
+            out, processed, rows_in, rows_out = chain.execute(
+                time, worker.lock, aud
+            )
+        finally:
+            _phases.stop(tok, "fused")
+        if not processed:
+            return False
+        elapsed_ns = _t.perf_counter_ns() - t0
+        chain.tail.stats_time_ns += elapsed_ns
+        if trace:
+            w1 = _t.time_ns()
+            dev_ns = _dev_prof.thread_device_wait_ns() - dev0
+            cold_ns = int((_dev_prof.thread_cold_s() - cold0) * 1e9)
+            name = f"sweep/chain{{{chain.label}}}"
+            attrs = {
+                "pathway.operator.id": chain.operator_ids(),
+                "pathway.worker": worker.index,
+                "pathway.chain.nodes": len(chain.members),
+                "pathway.rows_in": rows_in,
+                "pathway.rows_out": rows_out,
+                "pathway.device_ms": round(dev_ns / 1e6, 3),
+            }
+            if cold_ns:
+                attrs["pathway.compile_ms"] = round(cold_ns / 1e6, 3)
+            self.tracer.span(name, w0, w1, attrs)
+            if dev_ns:
+                _dev_prof.stats().note_span_split(
+                    name, max(0, elapsed_ns - dev_ns - cold_ns), dev_ns
+                )
+        self._route(worker, chain.tail, out)
+        return True
 
     def _parallel(self, fn) -> list:
         """Run fn(worker) on every worker concurrently; collect results.
@@ -255,6 +409,7 @@ class ShardedRuntime:
         target = self.workers[worker]
         with target.lock:
             target.graph.nodes[ci].accept(port, batch)
+            target.mark_dirty_locked(ci)
 
     def _sweep_round(self, time: int) -> bool:
         """All workers sweep concurrently, then the device plane flushes its
@@ -293,11 +448,16 @@ class ShardedRuntime:
                     aud.observe_input(node, polled, time)
             return polled
 
+        def _nodes(w, kind):
+            if w.plan is None:
+                return w.graph.nodes
+            return getattr(w.plan, kind)
+
         w0 = self.workers[0]
-        for node in w0.graph.nodes:
+        for node in _nodes(w0, "pollers"):
             self._route(w0, node, _polled(w0, node))
         for w in self.workers[1:]:
-            for node in w.graph.nodes:
+            for node in _nodes(w, "pollers"):
                 if getattr(node, "local_source", False):
                     self._route(w, node, _polled(w, node))
         while self._sweep_round(time):
@@ -306,7 +466,7 @@ class ShardedRuntime:
         while progressed:
             progressed = False
             for w in self.workers:
-                for node in w.graph.nodes:
+                for node in _nodes(w, "frontier_nodes"):
                     out = run_annotated(node, node.on_frontier, time)
                     if self._route(w, node, out):
                         progressed = True
@@ -314,7 +474,7 @@ class ShardedRuntime:
                 while self._sweep_round(time):
                     pass
         for w in self.workers:
-            for node in w.graph.nodes:
+            for node in _nodes(w, "tick_complete_nodes"):
                 run_annotated(node, node.on_tick_complete, time)
         for cb in self.on_tick_done:
             cb(time)

@@ -433,7 +433,9 @@ def _net_csv(text: str) -> dict:
     return {k: v for k, v in net.items() if v != 0}
 
 
-def _run_cluster_with_mode(script: str, out: str, mode: str, processes: int):
+def _run_cluster_with_mode(
+    script: str, out: str, mode: str, processes: int, extra_env: dict | None = None
+):
     import subprocess
 
     from test_cluster import REPO, _free_port_base
@@ -446,6 +448,8 @@ def _run_cluster_with_mode(script: str, out: str, mode: str, processes: int):
         JAX_PLATFORMS="cpu",
         PYTHONPATH=REPO,
     )
+    if extra_env:
+        env.update(extra_env)
     if processes > 1:
         env["PATHWAY_FIRST_PORT"] = str(_free_port_base(processes + 1))
     procs = []
@@ -463,6 +467,211 @@ def _run_cluster_with_mode(script: str, out: str, mode: str, processes: int):
     for p in procs:
         stdout, _ = p.communicate(timeout=120)
         assert p.returncode == 0, f"process exited {p.returncode}:\n{stdout}"
+
+
+# ------------------------------------------------- r15 fused tick kernels
+
+
+def _deltas_with_fuse(monkeypatch, fuse: str, incremental: bool = True):
+    from utils import deltas_of
+
+    monkeypatch.setenv("PATHWAY_FUSE", fuse)
+    try:
+        return deltas_of(_identity_pipeline(incremental=incremental))
+    finally:
+        monkeypatch.delenv("PATHWAY_FUSE", raising=False)
+
+
+def test_fused_vs_unfused_byte_identity_thread(monkeypatch):
+    """The r15 acceptance bar on the thread runtime: the RAW per-tick delta
+    stream (not just the net state) of the benched filter+join+groupby
+    pipeline with retractions is byte-identical with chains fused vs the
+    verbatim r14 sweep, for both the incremental and static runs."""
+    for incremental in (True, False):
+        fused = _deltas_with_fuse(monkeypatch, "on", incremental)
+        legacy = _deltas_with_fuse(monkeypatch, "off", incremental)
+        assert fused == legacy
+
+
+def test_fused_vs_unfused_byte_identity_sharded_2_workers(monkeypatch):
+    from pathway_tpu.internals.logical import LogicalNode
+    from pathway_tpu.parallel.sharded import ShardedRuntime
+
+    def run_sharded(fuse: str):
+        monkeypatch.setenv("PATHWAY_FUSE", fuse)
+        try:
+            table = _identity_pipeline(incremental=True)
+            cols = table.column_names()
+            holder = {}
+
+            def factory():
+                node = ops.CaptureNode(cols)
+                holder["n"] = node
+                return node
+
+            lnode = LogicalNode(factory, [table._node], name="capture")
+            rt = ShardedRuntime(n_workers=2, autocommit_duration_ms=5)
+            rt.run([lnode])
+            return dict(holder["n"].current)
+        finally:
+            monkeypatch.delenv("PATHWAY_FUSE", raising=False)
+
+    assert run_sharded("on") == run_sharded("off")
+
+
+def test_fused_vs_unfused_byte_identical_2proc_cluster(tmp_path):
+    """2-proc cluster: the written update stream must be byte-for-byte
+    identical with PATHWAY_FUSE=on vs off."""
+    script = tmp_path / "pipeline.py"
+    script.write_text(_CLUSTER_PIPELINE)
+    outs = {}
+    for fuse in ("on", "off"):
+        out = str(tmp_path / f"fuse_{fuse}")
+        _run_cluster_with_mode(
+            str(script), out, "incremental", 2, extra_env={"PATHWAY_FUSE": fuse}
+        )
+        outs[fuse] = open(out + ".csv").read()
+    assert outs["on"] == outs["off"]
+
+
+def test_fused_chain_embed_knn_rerank_byte_identity(monkeypatch):
+    """The serving-shaped chain (embed → KNN → rerank → selects) delivers a
+    byte-identical subscriber stream fused vs unfused."""
+    from pathway_tpu.stdlib.indexing import BruteForceKnnFactory
+    from pathway_tpu.xpacks.llm.mocks import FakeEmbedder
+    from pathway_tpu.xpacks.llm.rerankers import EncoderReranker
+    from pathway_tpu.internals.parse_graph import G
+
+    def run(fuse: str):
+        monkeypatch.setenv("PATHWAY_FUSE", fuse)
+        try:
+            G.clear()
+            emb = FakeEmbedder(dimension=12)
+            docs = [f"document number {i} about topic {i % 3}" for i in range(12)]
+            doc_t = pw.debug.table_from_rows(
+                pw.schema_from_types(text=str), [(d,) for d in docs]
+            )
+            index = BruteForceKnnFactory(embedder=emb).build_index(doc_t.text, doc_t)
+            q_t = pw.debug.table_from_rows(
+                pw.schema_from_types(qi=int, q=str),
+                [(i, docs[i], i // 4, 1) for i in range(8)],
+                is_stream=True,
+            )
+            picked = index.query_as_of_now(q_t.q, number_of_matches=1).select(
+                qi=pw.left.qi,
+                q=pw.left.q,
+                top=pw.apply(lambda ts: ts[0] if ts else "", pw.right.text),
+            )
+            rr = EncoderReranker(emb)
+            scored = picked.select(
+                picked.qi, picked.top, score=rr(picked.top, picked.q)
+            )
+            stream: list = []
+            pw.io.subscribe(
+                scored,
+                on_change=lambda key, row, time, is_addition: stream.append(
+                    (key, tuple(sorted(row.items())), is_addition)
+                ),
+            )
+            pw.run(monitoring_level="none")
+            return stream
+        finally:
+            monkeypatch.delenv("PATHWAY_FUSE", raising=False)
+
+    fused = run("on")
+    legacy = run("off")
+    assert fused and fused == legacy
+
+
+def test_fused_chain_smoke(monkeypatch):
+    """Tier-1-speed smoke: with PATHWAY_FUSE=on explicitly, the benched
+    pipeline builds a real multi-node chain with a composed expression
+    segment, fused ticks execute its compiled register program, and the
+    answer is right — fusion cannot silently rot behind the default."""
+    from pathway_tpu.engine import fusion
+
+    monkeypatch.setenv("PATHWAY_FUSE", "on")
+    built: list = []
+    ran: list = []
+    orig_plan = fusion.build_plan
+    orig_fast = fusion.ComposedSegment._run_fast
+
+    def spy_plan(graph, exchange_aware, transient=False):
+        plan = orig_plan(graph, exchange_aware, transient=transient)
+        built.append(plan)
+        return plan
+
+    def spy_fast(self, prog, batch, time, aud=None):
+        ran.append(len(batch))
+        return orig_fast(self, prog, batch, time, aud)
+
+    monkeypatch.setattr(fusion, "build_plan", spy_plan)
+    monkeypatch.setattr(fusion.ComposedSegment, "_run_fast", spy_fast)
+    t = pw.debug.table_from_rows(
+        pw.schema_from_types(k=int, v=int),
+        [(i % 5, i, i // 32, 1) for i in range(128)],
+        is_stream=True,
+    )
+    f = t.filter(t.v > 3)
+    s = f.select(k=f.k, d=f.v * 2)
+    s2 = s.select(k=s.k, d=s.d, e=s.d + 1)
+    g = s2.groupby(s2.k).reduce(s2.k, s=pw.reducers.sum(s2.e))
+    got = rows_of(g)
+    assert built and built[-1] is not None, "PATHWAY_FUSE=on must build a plan"
+    chains = built[-1].chains
+    assert chains, "benched pipeline must fuse at least one chain"
+    assert any(len(c.members) >= 3 for c in chains)
+    segs = [u[1] for c in chains for u in c.units if u[0] == "seg"]
+    assert segs, "filter+select+select must collapse into a ComposedSegment"
+    assert ran, "fused ticks must execute the compiled register program"
+    # and the answer matches the legacy engine
+    monkeypatch.setenv("PATHWAY_FUSE", "off")
+    assert got == rows_of(g)
+
+
+def test_fused_chain_jit_shape_set_closed_under_churn(monkeypatch):
+    """PATHWAY_FUSE_JAX=on: 50 ticks of churning row counts must keep the
+    fused chain kernel's jit shape set within the pow-2 bucket bound."""
+    jax = pytest.importorskip("jax")  # noqa: F841
+    from pathway_tpu.engine.jax_kernels import _bucket
+    from pathway_tpu.observability import device as device_mod
+
+    monkeypatch.setenv("PATHWAY_FUSE", "on")
+    monkeypatch.setenv("PATHWAY_FUSE_JAX", "on")
+    rng = np.random.default_rng(23)
+    sizes = [int(rng.integers(1, 900)) for _ in range(50)]
+    rows = []
+    for tick, sz in enumerate(sizes):
+        for i in range(sz):
+            rows.append((int(rng.integers(0, 50)), int(rng.integers(0, 100)), tick, 1))
+    t = pw.debug.table_from_rows(
+        pw.schema_from_types(k=int, v=int), rows, is_stream=True
+    )
+    f = t.filter(t.v > 10)
+    s = f.select(k=f.k, d=f.v * 3)
+    s2 = s.select(k=s.k, e=s.d + s.k)
+    # the plan (and its jit wrappers) die with the runtime — record the
+    # fused-chain wrappers as they are created
+    created: list = []
+    orig_tj = device_mod.traced_jit
+
+    def rec(label, fn):
+        w = orig_tj(label, fn)
+        if label.startswith("engine.fused_chain/"):
+            created.append(w)
+        return w
+
+    monkeypatch.setattr(device_mod, "traced_jit", rec)
+    out = rows_of(s2)
+    assert out
+    assert created, "the fused chain kernel was never built"
+    assert any(w.calls > 0 for w in created), "the jitted kernel never ran"
+    allowed = len({_bucket(sz) for sz in sizes})
+    for w in created:
+        assert len(w._seen) <= allowed, (
+            f"{w.label}: {len(w._seen)} jit shapes for {allowed} buckets — "
+            "the chain shape set is not closed under churn"
+        )
 
 
 def test_multimap_duplicate_delete_requests_do_not_corrupt_counts():
