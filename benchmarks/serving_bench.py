@@ -17,6 +17,11 @@ The acceptance surface of the r14 tentpole (production query-serving plane):
   stream floods the live index at 10× the query row rate while interactive
   clients keep querying. Gate (the r9 SLO multiple): flooded interactive p99
   within 3× unloaded.
+- **Request-trace overhead** (r16): the same coalesced serving work and the
+  flooded-interactive p99 measured with ``PATHWAY_REQUEST_TRACE`` on vs off,
+  interleaved per rep — the default-on plane must cost ≤``TRACE_OVERHEAD_PCT``
+  on both (hard gate under ``BENCH_MODE=1``, noisy-host downgrade), and the
+  on-legs' p99 per-stage latency decomposition lands in the BENCH json.
 - **Regression gate** (r11 discipline): ``serving_qps`` compares against the
   last committed ``BENCH_r*.json`` carrying it; drops past ``GATE_DROP_PCT``
   warn locally and exit 1 under ``BENCH_MODE=1``, downgraded to a warning on
@@ -70,6 +75,15 @@ SLO_MULTIPLE = 3.0  # r9 burst-test discipline: flooded p99 <= 3x unloaded
 GATE_LATENCY_X = 2.0
 GATE_TPUT_PCT = 80.0
 GATE_DROP_PCT = 25.0
+
+#: request-trace default-on overhead budget (qps and flooded p99, on vs off)
+TRACE_OVERHEAD_PCT = 5.0
+TRACE_CLIENTS = 16
+TRACE_REQS_PER_CLIENT = 5
+TRACE_REPS = 4  # even: each mode leads half the reps (order rotation)
+TRACE_FLOOD_CLIENTS = 8
+TRACE_FLOOD_REQS = 12
+TRACE_FLOOD_PAIRS = 2
 
 
 def synth_docs(n: int) -> list[str]:
@@ -498,6 +512,110 @@ def flood_leg(docs: list[str], rng: np.random.Generator) -> dict:
     }
 
 
+# ------------------------------------------------- leg 4: request-trace cost
+
+
+def request_trace_leg(docs: list[str], rng: np.random.Generator) -> dict:
+    """Default-on overhead of the request-trace plane: the SAME coalesced
+    serving work driven with ``PATHWAY_REQUEST_TRACE`` on vs off, interleaved
+    per rep with the mode ORDER rotated (r10 discipline — any per-session
+    warm-up or host drift lands on both modes equally; an untimed warm
+    session absorbs the cold compiles first), best-of per mode, plus rotated
+    flooded-interactive p99 pairs. The on-legs' p99 stage decomposition
+    (from the plane's per-stage histograms) is the BENCH record consumers
+    read."""
+    from pathway_tpu.observability import requests as req_mod
+
+    total = TRACE_CLIENTS * TRACE_REQS_PER_CLIENT
+
+    def fresh(tag: str) -> list[list[str]]:
+        qs = [
+            f"{docs[int(i)]} {tag}q{j}"
+            for j, i in enumerate(rng.integers(0, len(docs), total))
+        ]
+        return [
+            qs[ci * TRACE_REQS_PER_CLIENT : (ci + 1) * TRACE_REQS_PER_CLIENT]
+            for ci in range(TRACE_CLIENTS)
+        ]
+
+    # untimed warm session with the plane ON: concurrent-shape XLA compiles,
+    # serving-path imports and the plane's own allocation all land here, not
+    # in whichever mode happens to run first
+    os.environ["PATHWAY_REQUEST_TRACE"] = "on"
+    serve_session(
+        docs,
+        _concurrent_client(fresh("twarm")),
+        tick_mode="arrival",
+        autocommit_ms=TPUT_AUTOCOMMIT_MS,
+    )
+
+    qps = {"on": [], "off": []}
+    answers: dict[str, dict] = {}
+    stage_p99: dict = {}
+    for rep in range(TRACE_REPS):
+        per_client = fresh(f"t{rep}")
+        order = ("on", "off") if rep % 2 == 0 else ("off", "on")
+        for mode in order:
+            os.environ["PATHWAY_REQUEST_TRACE"] = mode
+            (wall, ans), _route, _fl = serve_session(
+                docs,
+                _concurrent_client(per_client),
+                tick_mode="arrival",
+                autocommit_ms=TPUT_AUTOCOMMIT_MS,
+            )
+            qps[mode].append(total / wall)
+            if rep == 0:
+                answers[mode] = ans
+            if mode == "on":
+                plane = req_mod.last()
+                if plane is not None:
+                    stage_p99 = plane.stage_snapshot()
+    # flooded interactive p99, on vs off (the r9 burst discipline, reduced):
+    # rotated pairs, best-of per mode — one flood session's scheduling jitter
+    # must not read as plane overhead
+    global FLOOD_CLIENTS, FLOOD_REQS_PER_CLIENT
+    prev_fc, prev_fr = FLOOD_CLIENTS, FLOOD_REQS_PER_CLIENT
+    FLOOD_CLIENTS, FLOOD_REQS_PER_CLIENT = TRACE_FLOOD_CLIENTS, TRACE_FLOOD_REQS
+    flood_p99: dict[str, list] = {"on": [], "off": []}
+    try:
+        for pair in range(TRACE_FLOOD_PAIRS):
+            order = ("on", "off") if pair % 2 == 0 else ("off", "on")
+            for mode in order:
+                os.environ["PATHWAY_REQUEST_TRACE"] = mode
+                flood_p99[mode].append(flood_leg(docs, rng)["flooded_p99_ms"])
+    finally:
+        FLOOD_CLIENTS, FLOOD_REQS_PER_CLIENT = prev_fc, prev_fr
+        os.environ.pop("PATHWAY_REQUEST_TRACE", None)
+    flood_p99 = {k: min(v) for k, v in flood_p99.items()}
+    qps_on, qps_off = max(qps["on"]), max(qps["off"])
+    spread = max(
+        max(v) / max(min(v), 1e-9) for v in qps.values()
+    )
+    overhead_qps_pct = round(100.0 * (1.0 - qps_on / qps_off), 2)
+    overhead_p99_pct = round(
+        100.0 * (flood_p99["on"] / max(flood_p99["off"], 1e-9) - 1.0), 2
+    )
+    return {
+        "qps_on": round(qps_on, 1),
+        "qps_off": round(qps_off, 1),
+        "overhead_qps_pct": overhead_qps_pct,
+        "flooded_p99_on_ms": flood_p99["on"],
+        "flooded_p99_off_ms": flood_p99["off"],
+        "overhead_flood_p99_pct": overhead_p99_pct,
+        "budget_pct": TRACE_OVERHEAD_PCT,
+        "rep_spread": round(spread, 2),
+        "byte_identical": answers.get("on") == answers.get("off"),
+        "stage_p99_s": {
+            k: v.get("p99_s") for k, v in stage_p99.items()
+        },
+        "stage_counts": {k: v.get("count") for k, v in stage_p99.items()},
+        "within_budget": bool(
+            overhead_qps_pct <= TRACE_OVERHEAD_PCT
+            and overhead_p99_pct <= TRACE_OVERHEAD_PCT
+        ),
+    }
+
+
 # ------------------------------------------------------------- regression gate
 
 
@@ -539,6 +657,7 @@ def full(n_docs: int = N_DOCS, out_path: str | None = None) -> dict:
             "PATHWAY_FLOW_BULK_MIN_ROWS",
             "PATHWAY_FLOW_BULK_MAX_ROWS",
             "PATHWAY_INPUT_QUEUE_ROWS",
+            "PATHWAY_REQUEST_TRACE",
         )
     }
     try:
@@ -553,13 +672,19 @@ def full(n_docs: int = N_DOCS, out_path: str | None = None) -> dict:
         lat = latency_leg(docs, [f"{docs[i % len(docs)]} l{i}" for i in range(LAT_REQS)])
         tput = throughput_leg(docs, rng)
         flood = flood_leg(docs, rng)
+        rtrace = request_trace_leg(docs, rng)
 
         results: dict = {
             "bench": "serving",
             "n_docs": n_docs,
             "preset": PRESET,
             "poll_autocommit_ms": POLL_AUTOCOMMIT_MS,
-            "serving": {"latency": lat, "throughput": tput, "flood": flood},
+            "serving": {
+                "latency": lat,
+                "throughput": tput,
+                "flood": flood,
+                "request_trace": rtrace,
+            },
             # top-level copies for the regression gate + BASELINE tables
             "serving_qps": tput["serving_qps"],
             "serving_latency_speedup_x": lat["speedup_p50_x"],
@@ -594,6 +719,23 @@ def full(n_docs: int = N_DOCS, out_path: str | None = None) -> dict:
                 f"flooded interactive p99 {flood['flooded_p99_ms']}ms > "
                 f"{SLO_MULTIPLE}x unloaded {flood['unloaded_p99_ms']}ms"
             )
+        if not rtrace["byte_identical"]:
+            gate_ok = False
+            failures.append("request tracing on vs off answers not byte-identical")
+        if not rtrace["within_budget"]:
+            msg = (
+                f"request-trace default-on overhead past {TRACE_OVERHEAD_PCT}%: "
+                f"qps {rtrace['overhead_qps_pct']}%, flooded p99 "
+                f"{rtrace['overhead_flood_p99_pct']}%"
+            )
+            if rtrace["rep_spread"] > 1.6:
+                print(
+                    f"WARNING (noisy host, trace gate downgraded): {msg}",
+                    file=sys.stderr,
+                )
+            else:
+                gate_ok = False
+                failures.append(msg)
         prev = _last_committed_qps(exclude=out_path)
         if prev is not None:
             prev_qps, prev_file = prev

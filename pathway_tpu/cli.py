@@ -241,6 +241,43 @@ def explain(host, port, sink, key):
         raise click.ClickException(doc.get("error", "explain request failed"))
 
 
+@cli.command()
+@click.argument("request_id", required=False)
+@click.option("--host", type=str, default="127.0.0.1", help="monitoring server host")
+@click.option(
+    "--port",
+    type=int,
+    default=None,
+    help="monitoring server port (default PATHWAY_MONITORING_HTTP_PORT, 20000)",
+)
+def trace(request_id, host, port):
+    """Print one request's end-to-end flight path: per-stage latency
+    decomposition + OTLP spans, from the request-trace plane's kept ring
+    (``/request`` endpoint). Omit REQUEST_ID to list kept trace ids and the
+    in-flight request table. The id is the ``X-Pathway-Request-Id`` response
+    header the REST front door stamps on every admitted request."""
+    import json as _json
+    import urllib.parse
+    import urllib.request
+
+    if port is None:
+        port = int(os.environ.get("PATHWAY_MONITORING_HTTP_PORT", "20000"))
+    url = f"http://{host}:{port}/request"
+    if request_id:
+        url += "?" + urllib.parse.urlencode({"id": request_id})
+    try:
+        body = urllib.request.urlopen(url, timeout=5).read().decode()
+    except OSError as e:
+        raise click.ClickException(
+            f"cannot reach monitoring server at {host}:{port}: {e} "
+            "(is the pipeline running with with_http_server=True?)"
+        ) from e
+    doc = _json.loads(body)
+    click.echo(_json.dumps(doc, indent=2))
+    if doc.get("ok") is False:
+        raise click.ClickException(doc.get("error", "trace request failed"))
+
+
 @cli.command(context_settings={"ignore_unknown_options": True})
 @click.option("--record-path", type=str, default="./record", help="recorded persistence root")
 @click.option(

@@ -197,6 +197,8 @@ class ComposedSegment:
         result is byte-identical to compact-at-every-filter."""
         from pathway_tpu.engine import operators as ops
 
+        from pathway_tpu.internals import trace as _trace
+
         keys = batch.keys
         diffs = batch.diffs
         data = batch.data
@@ -205,19 +207,34 @@ class ComposedSegment:
         mask: np.ndarray | None = None
         masks: list | None = [] if aud is not None else None
         counts: list[int] = [n]  # survivor count at each filter boundary
-        with np.errstate(all="ignore"):
-            for kind, fns in prog.instrs:
-                if kind == 0:  # rowwise batch of expr evaluations
-                    for fn in fns:
-                        regs.append(fn(regs, keys))
-                else:  # filter: fold into the lane mask
-                    m = fns(regs, keys)
-                    if not isinstance(m, np.ndarray):
-                        m = np.full(n, bool(m))
-                    mask = m if mask is None else mask & m
-                    counts.append(int(mask.sum()))
-                    if masks is not None:
-                        masks.append(mask)
+        # each instruction carries its owning member node: a raise inside the
+        # compiled program (the whitelist should preclude one, but numpy can
+        # still fail structurally) must attribute to the MEMBER, not fall
+        # through to whatever node label the thread last ran (the
+        # run_annotated discipline, same as _run_numpy's per-stage pin)
+        prev_node = getattr(_trace._tls, "node", None)
+        try:
+            with np.errstate(all="ignore"):
+                for kind, fns, owner in prog.instrs:
+                    _trace._tls.node = owner
+                    if kind == 0:  # rowwise batch of expr evaluations
+                        for fn in fns:
+                            regs.append(fn(regs, keys))
+                    else:  # filter: fold into the lane mask
+                        m = fns(regs, keys)
+                        if not isinstance(m, np.ndarray):
+                            m = np.full(n, bool(m))
+                        mask = m if mask is None else mask & m
+                        counts.append(int(mask.sum()))
+                        if masks is not None:
+                            masks.append(mask)
+        except Exception as e:
+            owner = getattr(_trace._tls, "node", None)
+            if owner is not None and owner is not prev_node:
+                _annotate(e, owner.name, getattr(owner, "user_trace", None))
+            raise
+        finally:
+            _trace._tls.node = prev_node
         if mask is not None:
             idx = np.flatnonzero(mask)
             out = {
@@ -349,7 +366,7 @@ class ComposedSegment:
                 d = infer_fused_dtype(st[2], cur)
                 if d is None or d.kind != "b":
                     return None
-                instrs.append((1, compile_fast(st[2], cur, slots)))
+                instrs.append((1, compile_fast(st[2], cur, slots), st[1]))
             elif st[0] == "rowwise":
                 from pathway_tpu.internals.expression import ColumnReference
 
@@ -371,7 +388,7 @@ class ComposedSegment:
                     nxt_s[name] = nregs
                     nregs += 1
                 if fns:
-                    instrs.append((0, fns))
+                    instrs.append((0, fns, st[1]))
                 cur, slots = nxt_d, nxt_s
             else:
                 _, _, columns, rename = st

@@ -23,6 +23,7 @@ from pathway_tpu.internals.trace import run_annotated as _run_annotated
 from pathway_tpu.observability import audit as _audit
 from pathway_tpu.observability import device as _device_prof
 from pathway_tpu.observability import engine_phases as _phases
+from pathway_tpu.observability import requests as _requests
 from pathway_tpu.resilience import faults as _faults
 
 END_OF_STREAM = np.iinfo(np.int64).max  # frontier value after all input closed
@@ -178,6 +179,11 @@ class Scheduler:
         # the hot loops below pay exactly one is-not-None test per guard
         self.tracer = None
         self._trace_active = False
+        self.transient = transient
+        # request-scoped tracing (observability/requests.py): the installed
+        # plane while a request is in flight this tick, else None — sweep
+        # steps pay one is-None test
+        self._rp = None
         from pathway_tpu.engine import fusion as _fusion
 
         # transient = a short-lived inner graph rebuilt per use (iterate's
@@ -218,6 +224,7 @@ class Scheduler:
         Active under ``PATHWAY_FUSE=off`` (plan is None)."""
         any_work = False
         trace = self._trace_active
+        rp = self._rp
         aud = _audit.current()
         aud_note = aud is not None and aud.edge_sampled
         for node in self.graph.nodes:
@@ -226,19 +233,28 @@ class Scheduler:
             inputs = node.drain()
             rows_in = sum(len(b) for b in inputs if b is not None)
             node.stats_rows_in += rows_in
-            if trace:
+            if trace or rp is not None:
                 w0 = _time.time_ns()
-                dev0 = _device_prof.thread_device_wait_ns()
+                dev0 = _device_prof.thread_device_wait_ns() if trace else 0
             t0 = _time.perf_counter_ns()
             out = _run_annotated(node, node.process, inputs, time)
             elapsed_ns = _time.perf_counter_ns() - t0
             node.stats_time_ns += elapsed_ns
+            if trace or rp is not None:
+                w1 = _time.time_ns()
+                if rp is not None and (
+                    rows_in
+                    or any(b is not None and not b.is_empty for b in out)
+                ):
+                    # a no-op visit (nothing drained, nothing emitted) touched
+                    # no request's rows — don't spend the per-tick ring budget
+                    rp.note_stage(time, f"sweep/{node.name}", w0, w1, rows_in)
             if trace:
                 dev_ns = _device_prof.thread_device_wait_ns() - dev0
                 self.tracer.span(
                     f"sweep/{node.name}",
                     w0,
-                    _time.time_ns(),
+                    w1,
                     {
                         "pathway.operator.id": node.node_index,
                         "pathway.rows_in": rows_in,
@@ -269,6 +285,7 @@ class Scheduler:
         self._heap = heap
         any_work = False
         trace = self._trace_active
+        rp = self._rp
         aud = _audit.current()
         # edge cardinality recording rides the audit plane's deterministic
         # tick sample — unsampled ticks pay only this flag read
@@ -293,21 +310,30 @@ class Scheduler:
                 inputs = node.drain()
                 rows_in = sum(len(b) for b in inputs if b is not None)
                 node.stats_rows_in += rows_in
-                if trace:
+                if trace or rp is not None:
                     w0 = _time.time_ns()
                     # host/device split: traced dispatches inside this node
                     # accumulate their block_until_ready wait on sampled ticks
-                    dev0 = _device_prof.thread_device_wait_ns()
+                    dev0 = _device_prof.thread_device_wait_ns() if trace else 0
                 t0 = _time.perf_counter_ns()
                 out = _run_annotated(node, node.process, inputs, time)
                 elapsed_ns = _time.perf_counter_ns() - t0
                 node.stats_time_ns += elapsed_ns
+                if trace or rp is not None:
+                    w1 = _time.time_ns()
+                    if rp is not None and (
+                        rows_in
+                        or any(b is not None and not b.is_empty for b in out)
+                    ):
+                        # a no-op visit (nothing drained, nothing emitted) touched
+                        # no request's rows — don't spend the per-tick ring budget
+                        rp.note_stage(time, f"sweep/{node.name}", w0, w1, rows_in)
                 if trace:
                     dev_ns = _device_prof.thread_device_wait_ns() - dev0
                     self.tracer.span(
                         f"sweep/{node.name}",
                         w0,
-                        _time.time_ns(),
+                        w1,
                         {
                             "pathway.operator.id": node.node_index,
                             "pathway.rows_in": rows_in,
@@ -335,10 +361,11 @@ class Scheduler:
         tail. Span + host/device attribution is per CHAIN — the device wait
         AND any inner traced-jit cold (compile) wall are subtracted from the
         host share so compile seconds stay counted once (r10 discipline)."""
-        if trace:
+        rp = self._rp
+        if trace or rp is not None:
             w0 = _time.time_ns()
-            dev0 = _device_prof.thread_device_wait_ns()
-            cold0 = _device_prof.thread_cold_s()
+            dev0 = _device_prof.thread_device_wait_ns() if trace else 0
+            cold0 = _device_prof.thread_cold_s() if trace else 0.0
         t0 = _time.perf_counter_ns()
         tok = _phases.start()
         try:
@@ -349,6 +376,10 @@ class Scheduler:
             return False
         elapsed_ns = _time.perf_counter_ns() - t0
         chain.tail.stats_time_ns += elapsed_ns
+        if rp is not None:
+            rp.note_stage(
+                time, f"sweep/chain{{{chain.label}}}", w0, _time.time_ns(), rows_in
+            )
         if trace:
             dev_ns = _device_prof.thread_device_wait_ns() - dev0
             cold_ns = int((_device_prof.thread_cold_s() - cold0) * 1e9)
@@ -380,6 +411,15 @@ class Scheduler:
         tracer = self.tracer
         tick_token = tracer.begin_tick(time) if tracer is not None else None
         self._trace_active = tick_token is not None
+        # request plane: active for this tick only while a request is in
+        # flight (one global read + one flag read); transient inner graphs
+        # (iterate bodies) keep their own tick numbering out of the ring
+        rp = None if self.transient else _requests.current()
+        if rp is not None and (not rp.hot or time == END_OF_STREAM):
+            rp = None
+        self._rp = rp
+        if rp is not None:
+            rp.note_tick(time)
         aud = _audit.current()
         if aud is not None:
             aud.begin_tick(time)

@@ -421,6 +421,55 @@ class PathwayConfig:
     def run_id(self) -> str:
         return os.environ.get("PATHWAY_RUN_ID", "")
 
+    # ---- request-scoped tracing (observability plane, serving side) ---------
+    @property
+    def request_trace(self) -> str:
+        """Request-scoped tracing plane (``observability/requests.py``):
+        ``on`` (default) mints a ``request_id`` per admitted REST request,
+        buffers its per-stage flight path in a bounded ring and keeps the
+        trace **tail-based** — on completion, iff it was slow
+        (``PATHWAY_REQUEST_TRACE_SLOW_MS``), errored/timed out, or falls in
+        the deterministic always-keep hash slice
+        (``PATHWAY_REQUEST_TRACE_KEEP``). ``off`` installs no plane at all —
+        engine hot loops pay one ``is None`` test and zero rings exist."""
+        raw = os.environ.get("PATHWAY_REQUEST_TRACE", "on").strip().lower()
+        if raw in ("", "1", "true", "yes", "on"):
+            return "on"
+        if raw in ("0", "false", "no", "off"):
+            return "off"
+        raise ValueError(f"PATHWAY_REQUEST_TRACE must be off/on, got {raw!r}")
+
+    @property
+    def request_trace_slow_ms(self) -> float:
+        """Tail-sampling latency threshold: a completed request whose
+        arrival-to-response latency is at least this keeps its trace (0 keeps
+        every trace — investigation mode)."""
+        v = _env_float("PATHWAY_REQUEST_TRACE_SLOW_MS", 250.0)
+        if v < 0:
+            raise ValueError(
+                f"PATHWAY_REQUEST_TRACE_SLOW_MS must be >= 0, got {v}"
+            )
+        return v
+
+    @property
+    def request_trace_keep(self) -> float:
+        """Deterministic always-keep slice in [0, 1]: the fraction of
+        request ids (by hash) whose traces are kept even when fast and
+        successful — the healthy-baseline exemplars slow traces are compared
+        against."""
+        v = _env_float("PATHWAY_REQUEST_TRACE_KEEP", 0.01)
+        if not 0.0 <= v <= 1.0:
+            raise ValueError(
+                f"PATHWAY_REQUEST_TRACE_KEEP must be in [0, 1], got {v}"
+            )
+        return v
+
+    @property
+    def request_trace_kept(self) -> int:
+        """Bounded ring of kept traces queryable via ``/request?id=`` and the
+        ``pathway_tpu trace`` CLI (oldest evicted first)."""
+        return max(8, _env_int("PATHWAY_REQUEST_TRACE_KEPT", 256))
+
     # ---- device profiling (observability plane, device side) ----------------
     @property
     def profile(self) -> str:
@@ -626,6 +675,10 @@ class PathwayConfig:
                 "audit",
                 "audit_sample",
                 "lineage_keys",
+                "request_trace",
+                "request_trace_slow_ms",
+                "request_trace_keep",
+                "request_trace_kept",
                 "flight_dir",
                 "run_id",
                 "engine_phases",

@@ -147,6 +147,14 @@ def run_stats(runtime) -> dict[str, Any]:
     serving = _rest_serve.serving_status(runtime)
     if serving is not None:
         stats["serving"] = serving
+    # request-scoped tracing plane: tail-sampling counters + the slowest-
+    # request exemplars (id + per-stage latency decomposition) — the serving
+    # section's "which queries are slow and where" answer
+    rp = _obs.requests.current()
+    if rp is not None:
+        stats["request_trace"] = rp.status_summary()
+        if serving is not None:
+            stats["serving"]["slowest"] = rp.slowest_exemplars()
     # live error log: per-operator row-level failure counts (UDF raises under
     # terminate_on_error=False — previously only visible via pw.global_error_log())
     from pathway_tpu.internals import error_log as _error_log
@@ -299,6 +307,10 @@ def prometheus_text(runtime) -> str:
     from pathway_tpu.io.http import _server as _rest_serve
 
     lines.extend(_rest_serve.serving_prometheus_lines(runtime))
+    # ---- request-scoped tracing (per-stage latency decomposition) -----------
+    rp = _obs.requests.current()
+    if rp is not None:
+        lines.extend(rp.prometheus_lines())
     # ---- device profiling plane (compiles, pad waste, memory, FLOPs) --------
     lines.extend(_obs.device.prometheus_lines(runtime))
     # ---- data-plane audit (edge cardinality, violations, divergences) -------
@@ -399,6 +411,33 @@ def _trace_payload(query: str) -> bytes:
     return json.dumps(doc).encode()
 
 
+def _request_payload(query: str) -> bytes:
+    """``/request?id=<request_id>``: one request's kept flight-path trace
+    (OTLP spans + per-stage latency decomposition), or its in-flight status.
+    With no ``id``, lists the kept trace ids and the in-flight table."""
+    from urllib.parse import parse_qs, unquote
+
+    from pathway_tpu.observability import requests as _requests
+
+    plane = _requests.current()
+    if plane is None:
+        return json.dumps(
+            {"ok": False, "error": "request tracing is off (PATHWAY_REQUEST_TRACE=off)"}
+        ).encode()
+    qs = parse_qs(query)
+    rid = unquote(qs["id"][0]) if qs.get("id") else None
+    if not rid:
+        return json.dumps(
+            {
+                "ok": True,
+                "kept_ids": plane.kept_ids(),
+                "in_flight": plane.inflight_table(),
+                "summary": plane.status_summary(),
+            }
+        ).encode()
+    return json.dumps(plane.get_trace(rid), default=str).encode()
+
+
 class MonitoringHttpServer:
     """``/status`` + ``/metrics`` + ``/trace`` over a daemon thread for the
     run's lifetime. Binds ``PATHWAY_MONITORING_HTTP_HOST`` (default loopback;
@@ -440,6 +479,9 @@ class MonitoringHttpServer:
                     ctype = "application/json"
                 elif path.rstrip("/") == "/explain":
                     body = _explain_payload(rt, query)
+                    ctype = "application/json"
+                elif path.rstrip("/") == "/request":
+                    body = _request_payload(query)
                     ctype = "application/json"
                 else:
                     self.send_response(404)

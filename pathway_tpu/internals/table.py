@@ -133,7 +133,7 @@ class Table:
     def select(self, *args: Any, **kwargs: Any) -> "Table":
         exprs = self._named_exprs(args, kwargs)
         tables = _referenced_tables(exprs.values())
-        tables.discard(self)
+        tables.pop(self, None)
         if not tables:
             schema = self._infer_schema(exprs)
             micro = _microbatch_factory(exprs, self, schema)
@@ -650,12 +650,20 @@ def _table_of(e: Any) -> Table | None:
 # ---------------------------------------------------------------------------- lowering helpers
 
 
-def _referenced_tables(exprs: Iterable[ColumnExpression]) -> set[Table]:
-    out: set[Table] = set()
+def _referenced_tables(exprs: Iterable[ColumnExpression]) -> dict[Table, None]:
+    """Tables referenced by ``exprs``, in FIRST-REFERENCE order (an ordered
+    dict used as an ordered set). Order is load-bearing: the multi-table
+    select lowers into a combine whose input PORTS follow this order, and a
+    cluster exchanges blocks by (node_index, port) — a ``set`` here ordered
+    sides by object address, so two processes of one cluster could build the
+    same logical combine with different port assignments and deliver a side's
+    rows to the wrong port (observed as a KeyError — or silent column mixups
+    when the schemas happen to agree)."""
+    out: dict[Table, None] = {}
 
     def walk(e: ColumnExpression) -> None:
         if isinstance(e, ColumnReference) and isinstance(e.table, Table):
-            out.add(e.table)
+            out.setdefault(e.table)
         if isinstance(e, expr_mod.PointerExpression) and isinstance(e.table, Table):
             pass  # pointer hashing doesn't need the table's data
         for a in e._args():

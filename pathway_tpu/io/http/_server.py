@@ -35,6 +35,7 @@ r14 turns the single-loop shim into a serving tier:
 from __future__ import annotations
 
 import asyncio
+import itertools
 import json as _json
 import threading
 import time as _time_mod
@@ -104,7 +105,6 @@ class _RouteServing:
         self.runtime: Any = None
         #: key -> (future, owning event loop, arrival time_ns, row values)
         self.futures: dict[int, tuple] = {}
-        self.seq = 0
         self.closed = True  # open between driver.start() and flush_pending()
         self.delete_completed = True
         # admission knobs, re-read per run in configure()
@@ -144,6 +144,12 @@ class _RouteServing:
         with self.lock:
             self.closed = True
             pending, self.futures = self.futures, {}
+        from pathway_tpu.observability import requests as _req_trace
+
+        rp = _req_trace.current()
+        if rp is not None:
+            for key in pending:
+                rp.drop(key)
         by_loop: dict[Any, list] = {}
         for fut, loop, _arrival_ns, _values in pending.values():
             by_loop.setdefault(loop, []).append((fut, _SHUTDOWN))
@@ -253,6 +259,12 @@ def _set_results(items: list[tuple]) -> None:
 #: every constructed route's serving state; weak so finished graphs release
 #: their routes (the monitoring plane filters by the queried runtime)
 _ROUTES: "weakref.WeakSet[_RouteServing]" = weakref.WeakSet()
+
+#: process-wide request-key mint shared by every route: a route-local counter
+#: would hand the Nth request of two routes the SAME engine key — and the
+#: request-trace plane keys its live table (and mints request/trace ids) by
+#: that raw key, so colliding keys would cross-wire two requests' flights
+_KEY_SEQ = itertools.count(1)
 
 
 def serving_status(runtime) -> dict[str, Any] | None:
@@ -474,7 +486,12 @@ class PathwayWebserver:
             loop = asyncio.new_event_loop()
             asyncio.set_event_loop(loop)
             self._loop = loop
-            runner = web.AppRunner(app)
+            # handler_cancellation: a disconnected client cancels its handler
+            # (aiohttp >= 3.9 defaults this OFF), so dead requests release
+            # their in-flight budget + request-trace record immediately
+            # instead of holding both until the 120 s timeout — the handler's
+            # CancelledError branch owns the cleanup
+            runner = web.AppRunner(app, handler_cancellation=True)
             try:
                 loop.run_until_complete(runner.setup())
                 site = web.TCPSite(runner, self.host, self.port)
@@ -618,12 +635,16 @@ def rest_connector(
     def _shed_response(reason: str):
         state.shed_total += 1
         from pathway_tpu import observability as _obs
+        from pathway_tpu.observability import requests as _req_trace
 
         tracer = _obs.current()
         if tracer is not None:
             tracer.event(
                 "serve/shed", {"pathway.route": route, "pathway.reason": reason}
             )
+        rp = _req_trace.current()
+        if rp is not None:
+            rp.note_shed(route, reason)
         status = 503 if reason == "shutting_down" else 429
         return web.json_response(
             {"error": "overloaded", "reason": reason},
@@ -674,20 +695,53 @@ def rest_connector(
                 # handlers can suspend there — the budget must bind where the
                 # futures dict actually grows
                 return _shed_response("max_inflight")
-            state.seq += 1
-            key = int(splitmix64(np.asarray([state.seq], dtype=np.uint64))[0])
+            key = int(splitmix64(np.asarray([next(_KEY_SEQ)], dtype=np.uint64))[0])
             state.futures[key] = (fut, loop, arrival_ns, values)
+        # request-scoped tracing: the admitted query row's engine key IS the
+        # request id (it rides the dataflow and the cluster wire for free).
+        # Registration happens BEFORE the push makes the row visible to the
+        # engine — a fast tick could otherwise resolve (and try to complete)
+        # the request before begin() ran, leaking it in the live table
+        from pathway_tpu.observability import requests as _req_trace
+
+        rp = _req_trace.current()
+        request_id = rp.begin(key, route, arrival_ns) if rp is not None else None
+        rid_headers = (
+            {"X-Pathway-Request-Id": request_id} if request_id is not None else None
+        )
         if not state.push_admitted(key, values):
             with state.lock:
                 state.futures.pop(key, None)
+            if rp is not None:
+                rp.drop(key)  # never reached the engine; no flight to trace
             return _shed_response("no_ingest_credit")
         state.schedule_tick()
         try:
             result = await asyncio.wait_for(fut, timeout=_REQUEST_TIMEOUT_S)
+        except asyncio.CancelledError:
+            # client disconnected: aiohttp cancels the handler task — the one
+            # exit where neither the response side nor the timeout branch
+            # runs, which would leak the in-flight record (pinning plane.hot)
+            # and the query row. Clean up like a timeout; futures.pop is the
+            # ownership token (ent None = the response side won the race and
+            # owns the retraction + completion)
+            with state.lock:
+                ent = state.futures.pop(key, None)
+            if ent is not None:
+                if rp is not None:
+                    rp.complete(key, "cancelled")
+                if state.delete_completed and state.node is not None:
+                    state.node._append_events([(key, values, -1)])
+                    state.schedule_tick()
+            raise
         except asyncio.TimeoutError:
             with state.lock:
                 ent = state.futures.pop(key, None)
             state.timeouts_total += 1
+            if rp is not None:
+                # a timed-out request is exactly what tail sampling exists
+                # for — its flight path is kept unconditionally
+                rp.complete(key, "timeout")
             # ent None = the response side won the race and already owns the
             # retraction; retracting again would push an unpaired -1
             if ent is not None and state.delete_completed and state.node is not None:
@@ -696,12 +750,16 @@ def rest_connector(
                 # retraction happens at response time, which never came)
                 state.node._append_events([(key, values, -1)])
                 state.schedule_tick()
-            return web.json_response({"error": "timeout"}, status=504)
-        if result is _SHUTDOWN:
             return web.json_response(
-                {"error": "engine shutting down"}, status=503
+                {"error": "timeout"}, status=504, headers=rid_headers
             )
-        return web.json_response(_jsonable(result))
+        if result is _SHUTDOWN:
+            if rp is not None:
+                rp.drop(key)  # no flight to decompose; the client got a 503
+            return web.json_response(
+                {"error": "engine shutting down"}, status=503, headers=rid_headers
+            )
+        return web.json_response(_jsonable(result), headers=rid_headers)
 
     ws._add_route(
         route,
@@ -770,7 +828,15 @@ def rest_connector(
             state.batches_total += 1
             state.batched_rows_total += len(resolved)
             from pathway_tpu import observability as _obs
+            from pathway_tpu.observability import requests as _req_trace
 
+            rp = _req_trace.current()
+            if rp is not None:
+                # completion runs the tail-based keep decision per request;
+                # the respond span covers this resolution pass
+                done_ns = _time_mod.time_ns()
+                for _ent, key, _row in resolved:
+                    rp.complete(key, "ok", now_ns, done_ns)
             tracer = _obs.current()
             if tracer is not None:
                 tracer.span(

@@ -31,6 +31,7 @@ from pathway_tpu.observability import (
     engine_phases,
     lineage,
     metrics,
+    requests,
     spans,
 )
 from pathway_tpu.observability.metrics import (
@@ -82,6 +83,9 @@ def install_from_env(runtime=None) -> Tracer | None:
     # data-plane audit (invariant monitors, cardinality gauges, shadow audits,
     # row lineage) — on by default, independent of the other planes
     audit.install_from_env(runtime)
+    # request-scoped tracing (per-request flight paths, tail-based sampling) —
+    # on by default; off installs no plane, hot loops pay one is-None test
+    requests.install_from_env(runtime)
     # host-side per-phase tick attribution (PATHWAY_ENGINE_PHASES=on):
     # consolidate/rehash/probe/realloc/kernel/exchange breakdown, read by
     # engine_bench — totals persist across runs until reset() so one bench
@@ -117,6 +121,7 @@ def shutdown() -> None:
     global _tracer
     device.shutdown()
     audit.shutdown()
+    requests.shutdown()
     if _tracer is None:
         return
     try:
@@ -143,6 +148,7 @@ __all__ = [
     "input_watermarks",
     "install_from_env",
     "metrics",
+    "requests",
     "run_metrics",
     "run_trace_id",
     "shutdown",

@@ -558,6 +558,16 @@ def flight_dump(
             "extra": extra,
             "device": status_summary(None),
         }
+        # request-trace plane: which user queries were mid-flight (and how
+        # far each got) when this process died — the post-mortem names them
+        try:
+            from pathway_tpu.observability import requests as _requests
+
+            rp = _requests.current()
+            if rp is not None:
+                doc["requests"] = rp.inflight_table()
+        except Exception:
+            pass
         if error is not None:
             doc["error"] = {
                 "type": type(error).__name__,

@@ -293,10 +293,12 @@ class Tracer:
         return f"{(self._id_base ^ (1 << 63) ^ seq) & _MASK:016x}"
 
     # records: (name, span_id | None, parent_id | None, start_ns, end_ns, attrs)
+    # — optionally extended with a 7th element overriding the trace id (the
+    # request plane's per-request trace ids stitch next to the run trace)
     def _materialize(self, seq: int, rec: tuple) -> dict:
-        name, span_id, parent_id, start_ns, end_ns, attrs = rec
+        name, span_id, parent_id, start_ns, end_ns, attrs = rec[:6]
         span = {
-            "traceId": self.trace_id,
+            "traceId": rec[6] if len(rec) > 6 else self.trace_id,
             "spanId": span_id if span_id is not None else self._seq_span_id(seq),
             "name": name,
             "kind": 1,
@@ -322,7 +324,9 @@ class Tracer:
             return r
 
         parts = []
-        for seq, (name, span_id, parent_id, start_ns, end_ns, attrs) in batch:
+        for seq, rec in batch:
+            name, span_id, parent_id, start_ns, end_ns, attrs = rec[:6]
+            trace_id = rec[6] if len(rec) > 6 else self.trace_id
             if span_id is None:
                 span_id = self._seq_span_id(seq)
             a_parts = []
@@ -341,7 +345,7 @@ class Tracer:
                 f'"parentSpanId":"{parent_id}",' if parent_id is not None else ""
             )
             parts.append(
-                f'{{"traceId":"{self.trace_id}","spanId":"{span_id}",{parent}'
+                f'{{"traceId":"{trace_id}","spanId":"{span_id}",{parent}'
                 f'"name":{cdumps(name)},"kind":1,'
                 f'"startTimeUnixNano":"{start_ns}","endTimeUnixNano":"{end_ns}",'
                 f'"attributes":[{",".join(a_parts)}]}}'

@@ -93,12 +93,18 @@ class MicrobatchDispatcher:
         on its autocommit deadline."""
         from pathway_tpu import observability as _obs
         from pathway_tpu.observability import device as _dev
+        from pathway_tpu.observability import requests as _requests
 
         tracer = _obs.current() if self.label is not None else None
         if tracer is not None and tracer.tick_span_id is None:
             # head sampling: an unsampled tick records NO spans — dispatches
             # included (same gate as MicrobatchApplyNode's launch span)
             tracer = None
+        # request plane: launches are stage events of every in-flight request
+        # regardless of head sampling (tail sampling decides keep later)
+        rp = _requests.current() if self.label is not None else None
+        if rp is not None and not rp.hot:
+            rp = None
         stats = _dev.stats()
         profiled = stats.enabled
         out: list = []
@@ -121,13 +127,28 @@ class MicrobatchDispatcher:
             else:
                 cold = tracer is not None and tracer.first_shape(self.label, b)
             try:
-                if tracer is not None or cold:
+                if tracer is not None or cold or rp is not None:
                     import time as _t
 
                     inner0 = _dev.thread_cold_s()
                     w0 = _t.time_ns()
                     results = self.fn(padded)
                     w1 = _t.time_ns()
+                    if rp is not None:
+                        # pad share + cold-compile attribution ride the
+                        # request flight path (the serving tier's "why was
+                        # this query slow" often reads "cold bucket compile")
+                        rattrs = {
+                            "udf": label,
+                            "bucket": b,
+                            "pad": b - n,
+                            "cold": cold,
+                        }
+                        if cold:
+                            rattrs["compile_ms"] = round((w1 - w0) / 1e6, 3)
+                        rp.note_stage(
+                            None, f"microbatch/{label}", w0, w1, n, rattrs
+                        )
                     if cold and profiled:
                         # measured compile wall time: the cold call pays jit
                         # trace + XLA compile (+ one execution) — accumulated

@@ -45,6 +45,7 @@ from pathway_tpu.internals.logical import BuildContext, LogicalNode
 from pathway_tpu.internals.trace import run_annotated
 from pathway_tpu.observability import audit as _audit
 from pathway_tpu.observability import engine_phases as _phases
+from pathway_tpu.observability import requests as _requests
 from pathway_tpu.parallel.mesh import shard_of_keys
 from pathway_tpu.resilience import faults as _faults
 
@@ -467,6 +468,9 @@ class ClusterRuntime:
         # live tracing (observability): installed in run(), None when off
         self.tracer = None
         self._trace_active = False
+        # request-scoped tracing: the plane while a request is in flight this
+        # tick, else None (see engine.graph.Scheduler)
+        self._rp = None
         self.local_workers: dict[int, _LocalWorker] = {}
         # intra-process rows ride the local mesh; cross-process rows take the
         # TCP links (the ICI/DCN split — see parallel/device_plane.py)
@@ -602,6 +606,7 @@ class ClusterRuntime:
         """The r14 per-worker sweep, verbatim (PATHWAY_FUSE=off)."""
         any_work = False
         trace = self._trace_active
+        rp = self._rp
         aud = _audit.current()
         aud_note = aud is not None and aud.edge_sampled
         for node in lw.graph.nodes:
@@ -611,14 +616,22 @@ class ClusterRuntime:
                 inputs = node.drain()
             rows_in = sum(len(b) for b in inputs if b is not None)
             node.stats_rows_in += rows_in
-            if trace:
+            if trace or rp is not None:
                 from pathway_tpu.observability import device as _dev_prof
 
                 w0 = _time.time_ns()
-                dev0 = _dev_prof.thread_device_wait_ns()
+                dev0 = _dev_prof.thread_device_wait_ns() if trace else 0
             out = run_annotated(node, node.process, inputs, time)
-            if trace:
+            if trace or rp is not None:
                 w1 = _time.time_ns()
+                if rp is not None and (
+                    rows_in
+                    or any(b is not None and not b.is_empty for b in out)
+                ):
+                    # a no-op visit (nothing drained, nothing emitted) touched
+                    # no request's rows — don't spend the per-tick ring budget
+                    rp.note_stage(time, f"sweep/{node.name}", w0, w1, rows_in)
+            if trace:
                 dev_ns = _dev_prof.thread_device_wait_ns() - dev0
                 self.tracer.span(
                     f"sweep/{node.name}",
@@ -652,6 +665,7 @@ class ClusterRuntime:
         lw.sweep_heap = heap
         any_work = False
         trace = self._trace_active
+        rp = self._rp
         aud = _audit.current()
         aud_note = aud is not None and aud.edge_sampled
         by_pos = lw.plan.by_pos
@@ -675,14 +689,22 @@ class ClusterRuntime:
                     inputs = node.drain()
                 rows_in = sum(len(b) for b in inputs if b is not None)
                 node.stats_rows_in += rows_in
-                if trace:
+                if trace or rp is not None:
                     from pathway_tpu.observability import device as _dev_prof
 
                     w0 = _time.time_ns()
-                    dev0 = _dev_prof.thread_device_wait_ns()
+                    dev0 = _dev_prof.thread_device_wait_ns() if trace else 0
                 out = run_annotated(node, node.process, inputs, time)
-                if trace:
+                if trace or rp is not None:
                     w1 = _time.time_ns()
+                    if rp is not None and (
+                        rows_in
+                        or any(b is not None and not b.is_empty for b in out)
+                    ):
+                        # a no-op visit (nothing drained, nothing emitted) touched
+                        # no request's rows — don't spend the per-tick ring budget
+                        rp.note_stage(time, f"sweep/{node.name}", w0, w1, rows_in)
+                if trace:
                     dev_ns = _dev_prof.thread_device_wait_ns() - dev0
                     self.tracer.span(
                         f"sweep/{node.name}",
@@ -712,10 +734,11 @@ class ClusterRuntime:
         device wait and traced-jit cold walls subtracted from host share)."""
         from pathway_tpu.observability import device as _dev_prof
 
-        if trace:
+        rp = self._rp
+        if trace or rp is not None:
             w0 = _time.time_ns()
-            dev0 = _dev_prof.thread_device_wait_ns()
-            cold0 = _dev_prof.thread_cold_s()
+            dev0 = _dev_prof.thread_device_wait_ns() if trace else 0
+            cold0 = _dev_prof.thread_cold_s() if trace else 0.0
         t0 = _time.perf_counter_ns()
         tok = _phases.start()
         try:
@@ -726,6 +749,10 @@ class ClusterRuntime:
             return False
         elapsed_ns = _time.perf_counter_ns() - t0
         chain.tail.stats_time_ns += elapsed_ns
+        if rp is not None:
+            rp.note_stage(
+                time, f"sweep/chain{{{chain.label}}}", w0, _time.time_ns(), rows_in
+            )
         if trace:
             w1 = _time.time_ns()
             dev_ns = _dev_prof.thread_device_wait_ns() - dev0
@@ -775,24 +802,67 @@ class ClusterRuntime:
 
     def _barrier(self, report: Any, decide) -> Any:
         _faults.before_barrier(self.pid, self.current_time)
+        phase = report[0] if isinstance(report, tuple) and report else "barrier"
+        # request-trace piggyback: peers ship their stage-event outbox on
+        # barrier reports; the coordinator merges them and broadcasts the
+        # live-request table with the decision — one request's flight path
+        # stitches across processes with zero extra sockets or rounds. Both
+        # directions are pay-as-you-go: an empty outbox ships nothing, and
+        # the broadcast rides only while requests are live (one trailing
+        # empty broadcast clears peers), so a cluster job with no traffic
+        # adds no barrier payload at all
+        rp = _requests.current()
+        if rp is not None:
+            outbox = rp.wire_out()
+            if outbox is not None:
+                report = ("__rt__", report, outbox)
+            if self.pid == 0 and decide is not None:
+                inner_decide = decide
+
+                def decide(reports, _inner=inner_decide, _rp=rp):
+                    # unwrap is per-report and tag-based: wrapped and bare
+                    # reports mix freely (peers wrap only when shipping)
+                    base = []
+                    for r in reports:
+                        if (
+                            isinstance(r, tuple)
+                            and len(r) == 3
+                            and r[0] == "__rt__"
+                        ):
+                            _rp.wire_merge(r[2])
+                            base.append(r[1])
+                        else:
+                            base.append(r)
+                    d = _inner(base)
+                    if isinstance(d, dict):
+                        bc = _rp.wire_broadcast()
+                        if bc is not None:
+                            d = dict(d)
+                            d["__rt_bc__"] = bc
+                    return d
+
         if not self._trace_active:
             if self.pid == 0:
-                return self.coord.barrier(report, decide)
-            return self.client.barrier(report)
-        # sampled tick: record the barrier round as a child span — wait time
-        # at barriers IS the cluster's skew/critical-path signal (SnailTrail)
-        phase = report[0] if isinstance(report, tuple) and report else "barrier"
-        w0 = _time.time_ns()
-        if self.pid == 0:
-            decision = self.coord.barrier(report, decide)
+                decision = self.coord.barrier(report, decide)
+            else:
+                decision = self.client.barrier(report)
         else:
-            decision = self.client.barrier(report)
-        self.tracer.span(
-            f"cluster/barrier/{phase}",
-            w0,
-            _time.time_ns(),
-            {"pathway.process_id": self.pid, "pathway.tick": self.current_time},
-        )
+            # sampled tick: record the barrier round as a child span — wait
+            # time at barriers IS the cluster's skew/critical-path signal
+            # (SnailTrail)
+            w0 = _time.time_ns()
+            if self.pid == 0:
+                decision = self.coord.barrier(report, decide)
+            else:
+                decision = self.client.barrier(report)
+            self.tracer.span(
+                f"cluster/barrier/{phase}",
+                w0,
+                _time.time_ns(),
+                {"pathway.process_id": self.pid, "pathway.tick": self.current_time},
+            )
+        if rp is not None and self.pid != 0 and isinstance(decision, dict):
+            rp.wire_apply(decision.get("__rt_bc__"))
         return decision
 
     def _round_until_quiescent(self, time: int, phase: str) -> None:
@@ -877,6 +947,12 @@ class ClusterRuntime:
         tracer = self.tracer
         tick_token = tracer.begin_tick(time) if tracer is not None else None
         self._trace_active = tick_token is not None
+        rp = _requests.current()
+        if rp is not None and (not rp.hot or time == END_OF_STREAM):
+            rp = None
+        self._rp = rp
+        if rp is not None:
+            rp.note_tick(time)
         if self.hb_client is not None:
             self.hb_client.tick = time
         # non-partitioned sources poll on global worker 0 only; partitioned

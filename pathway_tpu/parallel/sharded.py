@@ -47,6 +47,7 @@ from pathway_tpu.internals.logical import BuildContext, LogicalNode
 from pathway_tpu.internals.trace import run_annotated
 from pathway_tpu.observability import audit as _audit
 from pathway_tpu.observability import engine_phases as _phases
+from pathway_tpu.observability import requests as _requests
 from pathway_tpu.parallel.mesh import shard_of_keys
 from pathway_tpu.resilience import faults as _faults
 
@@ -106,6 +107,9 @@ class ShardedRuntime:
         # live tracing (observability): installed in run(), None when off
         self.tracer = None
         self._trace_active = False
+        # request-scoped tracing: the plane while a request is in flight this
+        # tick, else None (see engine.graph.Scheduler)
+        self._rp = None
         # on-device all_to_all exchange for numeric blocks (None = host-only;
         # see parallel/device_plane.py and PATHWAY_DEVICE_EXCHANGE)
         from pathway_tpu.parallel.device_plane import make_device_plane
@@ -219,6 +223,7 @@ class ShardedRuntime:
 
         any_work = False
         trace = self._trace_active
+        rp = self._rp
         aud = _audit.current()
         aud_note = aud is not None and aud.edge_sampled
         for node in worker.graph.nodes:
@@ -228,14 +233,22 @@ class ShardedRuntime:
                 inputs = node.drain()
             rows_in = sum(len(b) for b in inputs if b is not None)
             node.stats_rows_in += rows_in
-            if trace:
+            if trace or rp is not None:
                 from pathway_tpu.observability import device as _dev_prof
 
                 w0 = _t.time_ns()
-                dev0 = _dev_prof.thread_device_wait_ns()
+                dev0 = _dev_prof.thread_device_wait_ns() if trace else 0
             out = run_annotated(node, node.process, inputs, time)
-            if trace:
+            if trace or rp is not None:
                 w1 = _t.time_ns()
+                if rp is not None and (
+                    rows_in
+                    or any(b is not None and not b.is_empty for b in out)
+                ):
+                    # a no-op visit (nothing drained, nothing emitted) touched
+                    # no request's rows — don't spend the per-tick ring budget
+                    rp.note_stage(time, f"sweep/{node.name}", w0, w1, rows_in)
+            if trace:
                 dev_ns = _dev_prof.thread_device_wait_ns() - dev0
                 self.tracer.span(
                     f"sweep/{node.name}",
@@ -272,6 +285,7 @@ class ShardedRuntime:
         worker.sweep_heap = heap
         any_work = False
         trace = self._trace_active
+        rp = self._rp
         aud = _audit.current()
         aud_note = aud is not None and aud.edge_sampled
         by_pos = worker.plan.by_pos
@@ -295,14 +309,22 @@ class ShardedRuntime:
                     inputs = node.drain()
                 rows_in = sum(len(b) for b in inputs if b is not None)
                 node.stats_rows_in += rows_in
-                if trace:
+                if trace or rp is not None:
                     from pathway_tpu.observability import device as _dev_prof
 
                     w0 = _t.time_ns()
-                    dev0 = _dev_prof.thread_device_wait_ns()
+                    dev0 = _dev_prof.thread_device_wait_ns() if trace else 0
                 out = run_annotated(node, node.process, inputs, time)
-                if trace:
+                if trace or rp is not None:
                     w1 = _t.time_ns()
+                    if rp is not None and (
+                        rows_in
+                        or any(b is not None and not b.is_empty for b in out)
+                    ):
+                        # a no-op visit (nothing drained, nothing emitted) touched
+                        # no request's rows — don't spend the per-tick ring budget
+                        rp.note_stage(time, f"sweep/{node.name}", w0, w1, rows_in)
+                if trace:
                     dev_ns = _dev_prof.thread_device_wait_ns() - dev0
                     self.tracer.span(
                         f"sweep/{node.name}",
@@ -338,10 +360,11 @@ class ShardedRuntime:
 
         from pathway_tpu.observability import device as _dev_prof
 
-        if trace:
+        rp = self._rp
+        if trace or rp is not None:
             w0 = _t.time_ns()
-            dev0 = _dev_prof.thread_device_wait_ns()
-            cold0 = _dev_prof.thread_cold_s()
+            dev0 = _dev_prof.thread_device_wait_ns() if trace else 0
+            cold0 = _dev_prof.thread_cold_s() if trace else 0.0
         t0 = _t.perf_counter_ns()
         tok = _phases.start()
         try:
@@ -354,6 +377,10 @@ class ShardedRuntime:
             return False
         elapsed_ns = _t.perf_counter_ns() - t0
         chain.tail.stats_time_ns += elapsed_ns
+        if rp is not None:
+            rp.note_stage(
+                time, f"sweep/chain{{{chain.label}}}", w0, _t.time_ns(), rows_in
+            )
         if trace:
             w1 = _t.time_ns()
             dev_ns = _dev_prof.thread_device_wait_ns() - dev0
@@ -430,6 +457,12 @@ class ShardedRuntime:
         tracer = self.tracer
         tick_token = tracer.begin_tick(time) if tracer is not None else None
         self._trace_active = tick_token is not None
+        rp = _requests.current()
+        if rp is not None and (not rp.hot or time == END_OF_STREAM):
+            rp = None
+        self._rp = rp
+        if rp is not None:
+            rp.note_tick(time)
         # non-partitioned sources live on worker 0 only — peers' copies never
         # poll (polling them would duplicate every input row per worker);
         # partitioned sources (``local_source``) poll on their OWN worker,

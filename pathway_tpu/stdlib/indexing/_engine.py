@@ -468,7 +468,19 @@ class ExternalIndexNode(Node):
             # batched search — TPU-friendly), else just the new ones
             to_answer = list(self._live_queries) if docs_changed else new_queries
         if to_answer:
-            replies = self._answer(to_answer)
+            from pathway_tpu.observability import requests as _requests
+
+            rp = _requests.current()
+            if rp is not None and rp.hot:
+                import time as _t
+
+                w0 = _t.time_ns()
+                replies = self._answer(to_answer)
+                rp.note_stage(
+                    None, "index/search", w0, _t.time_ns(), len(to_answer)
+                )
+            else:
+                replies = self._answer(to_answer)
             for k, reply in zip(to_answer, replies):
                 query_k = self._live_queries[k][1]
                 old = self._emitted.get(k)

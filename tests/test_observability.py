@@ -722,3 +722,77 @@ def test_cluster_monitoring_and_trace_stitching(tmp_path):
         for p in procs:
             if p.poll() is None:
                 p.kill()
+
+
+# --------------------------------------------- fused-chain error attribution
+
+
+def test_fused_chain_error_attributed_to_member_not_tail(monkeypatch):
+    """ISSUE 13 satellite: a UDF raise inside a fused chain must attribute to
+    the raising MEMBER on ``pathway_operator_errors_total{op}``, not to the
+    chain tail (the chain executes as ONE sweep step; the per-member
+    ``_tls.node`` pin inside the segment/unit walk is what keeps row-level
+    error reports honest)."""
+    import pathway_tpu as pw
+    from pathway_tpu.internals import error_log
+    from pathway_tpu.internals.monitoring import prometheus_text
+
+    monkeypatch.setenv("PATHWAY_TERMINATE_ON_ERROR", "0")
+    monkeypatch.setenv("PATHWAY_FUSE", "on")
+    error_log.clear()
+
+    class S(pw.Schema):
+        x: int
+
+    t = pw.debug.table_from_rows(S, [(i,) for i in range(8)])
+
+    def boom(v):
+        if v == 5:
+            raise ValueError("mid-chain boom")
+        return v * 10
+
+    mid = t.select(y=pw.apply(boom, t.x))  # the raising MEMBER (a select)
+    # the chain TAIL is a different operator kind, so a tail-attributed error
+    # would be unmistakable ("filter:N" instead of "select:N")
+    tail = mid.filter(mid.y >= 0)
+    rows: list = []
+    pw.io.subscribe(tail, lambda key, row, time, is_addition: rows.append(row))
+    pw.run(monitoring_level="none", terminate_on_error=False)
+    rt = pw.internals.run.current_runtime()
+    counts = error_log.operator_error_counts()
+    assert counts, "row-level failure was not logged at all"
+    ((label, n),) = counts.items()
+    assert n == 1
+    # the label names the raising member's operator, never the chain tail
+    assert label.startswith("select:"), f"error attributed to {label}"
+    # /metrics carries the member-labelled counter
+    text = prometheus_text(rt)
+    assert f'pathway_operator_errors_total{{op="{label}"}} 1' in text
+    error_log.clear()
+
+
+def test_fused_chain_filter_error_attributed_to_filter(monkeypatch):
+    """Same contract for a raising FILTER member mid-chain."""
+    import pathway_tpu as pw
+    from pathway_tpu.internals import error_log
+
+    monkeypatch.setenv("PATHWAY_TERMINATE_ON_ERROR", "0")
+    monkeypatch.setenv("PATHWAY_FUSE", "on")
+    error_log.clear()
+
+    class S(pw.Schema):
+        x: int
+
+    t = pw.debug.table_from_rows(S, [(i,) for i in range(6)])
+
+    def keep(v):
+        if v == 2:
+            raise ValueError("filter boom")
+        return True
+
+    mid = t.filter(pw.apply(keep, t.x))
+    tail = mid.select(z=mid.x + 1)
+    pw.debug.table_to_pandas(tail)
+    counts = error_log.operator_error_counts()
+    assert counts and all(l.startswith("filter:") for l in counts), counts
+    error_log.clear()

@@ -1,0 +1,248 @@
+"""OTLP-JSON line-schema validation (ISSUE 13 satellite).
+
+Every span/event kind the repo emits — r8 tick + sweep spans, r10 device
+dispatches, microbatch launches, serving events, r12 audit events, and the
+new request-scoped spans — must round-trip through one checked schema, so a
+sink-format drift fails tier-1 instead of breaking downstream collectors
+(Perfetto / otel-desktop-viewer / the OTel file-exporter convention).
+
+Validated on BOTH read paths: the materialized span dicts served by
+``/trace?since=`` and the direct string-built ``ExportTraceServiceRequest``
+lines the rotating file sink writes (they are separate serializers by
+design — the fast path must not drift from the dict path).
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import socket
+import threading
+import time
+import urllib.request
+
+import pytest
+
+import pathway_tpu as pw
+from pathway_tpu import observability as obs
+from pathway_tpu.observability import requests as req_mod
+
+_HEX32 = re.compile(r"^[0-9a-f]{32}$")
+_HEX16 = re.compile(r"^[0-9a-f]{16}$")
+_DIGITS = re.compile(r"^[0-9]+$")
+
+#: the exhaustive attribute-value box set this repo emits
+_VALUE_KEYS = {"stringValue", "intValue", "doubleValue", "boolValue"}
+
+
+def validate_span(span: dict) -> None:
+    """One OTLP span object against the repo's emitted schema."""
+    allowed = {
+        "traceId",
+        "spanId",
+        "parentSpanId",
+        "name",
+        "kind",
+        "startTimeUnixNano",
+        "endTimeUnixNano",
+        "attributes",
+    }
+    assert set(span) <= allowed, f"unknown span fields: {set(span) - allowed}"
+    assert _HEX32.match(span["traceId"]), span
+    assert _HEX16.match(span["spanId"]), span
+    if "parentSpanId" in span:
+        assert _HEX16.match(span["parentSpanId"]), span
+    assert isinstance(span["name"], str) and span["name"]
+    assert span["kind"] == 1
+    assert _DIGITS.match(span["startTimeUnixNano"]), span
+    assert _DIGITS.match(span["endTimeUnixNano"]), span
+    assert int(span["endTimeUnixNano"]) >= int(span["startTimeUnixNano"]), span
+    assert isinstance(span["attributes"], list)
+    for attr in span["attributes"]:
+        assert set(attr) == {"key", "value"}, attr
+        assert isinstance(attr["key"], str) and attr["key"]
+        v = attr["value"]
+        assert isinstance(v, dict) and len(v) == 1, attr
+        (vk, vv), = v.items()
+        assert vk in _VALUE_KEYS, attr
+        if vk == "intValue":
+            assert isinstance(vv, str) and re.match(r"^-?[0-9]+$", vv), attr
+        elif vk == "doubleValue":
+            assert isinstance(vv, (int, float)), attr
+        elif vk == "boolValue":
+            assert isinstance(vv, bool), attr
+        else:
+            assert isinstance(vv, str), attr
+
+
+def validate_export_line(line: str) -> list[dict]:
+    """One file-sink line as a full ExportTraceServiceRequest document."""
+    doc = json.loads(line)
+    assert set(doc) == {"resourceSpans"}, doc.keys()
+    spans_out = []
+    for rs in doc["resourceSpans"]:
+        assert set(rs) == {"resource", "scopeSpans"}
+        for attr in rs["resource"]["attributes"]:
+            assert set(attr) == {"key", "value"}
+        for ss in rs["scopeSpans"]:
+            assert set(ss) == {"scope", "spans"}
+            assert ss["scope"]["name"] == "pathway_tpu.live"
+            for span in ss["spans"]:
+                validate_span(span)
+                spans_out.append(span)
+    return spans_out
+
+
+def _free_port() -> int:
+    s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def test_all_emitted_span_kinds_round_trip(tmp_path, monkeypatch):
+    """Drive a real serving pipeline with every plane on and validate every
+    span it emitted — through the ring-buffer dict path AND the file-sink
+    line path — then assert the core span-kind coverage so a silently
+    missing emitter can't pass as 'nothing to validate'."""
+    trace_file = tmp_path / "live.otlpjson"
+    monkeypatch.setenv("PATHWAY_TRACE", "on")
+    monkeypatch.setenv("PATHWAY_TRACE_SAMPLE", "1.0")
+    monkeypatch.setenv("PATHWAY_TRACE_LIVE_FILE", str(trace_file))
+    monkeypatch.setenv("PATHWAY_REQUEST_TRACE", "on")
+    monkeypatch.setenv("PATHWAY_REQUEST_TRACE_SLOW_MS", "0")  # keep all
+    port = _free_port()
+
+    from pathway_tpu.internals.parse_graph import G
+    from pathway_tpu.stdlib.indexing import BruteForceKnnFactory
+    from pathway_tpu.xpacks.llm.mocks import FakeEmbedder
+
+    G.clear()
+    emb = FakeEmbedder(dimension=8, deterministic=True)
+    doc_t = pw.debug.table_from_rows(
+        pw.schema_from_types(text=str), [(f"doc {i}",) for i in range(8)]
+    )
+    index = BruteForceKnnFactory(embedder=emb, reserved_space=32).build_index(
+        doc_t.text, doc_t
+    )
+    queries, respond = pw.io.http.rest_connector(
+        host="127.0.0.1", port=port, schema=pw.schema_from_types(query=str)
+    )
+    picked = index.query_as_of_now(queries.query, number_of_matches=1).select(
+        top=pw.apply(lambda ts: ts[0] if ts else "", pw.right.text)
+    )
+    respond(picked.select(result=picked.top))
+
+    captured: dict = {}
+
+    def orchestrate() -> None:
+        deadline = time.monotonic() + 20
+        while time.monotonic() < deadline:
+            try:
+                socket.create_connection(("127.0.0.1", port), timeout=0.5).close()
+                break
+            except OSError:
+                time.sleep(0.02)
+        for i in range(3):
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{port}/",
+                data=json.dumps({"query": f"doc {i}"}).encode(),
+                headers={"Content-Type": "application/json"},
+            )
+            urllib.request.urlopen(req, timeout=30).read()
+        # audit/violation event shape: emitted through the same tracer.event
+        # machinery the audit plane uses (provoking a real data-corruption
+        # fault here would abort the run)
+        tracer = obs.current()
+        tracer.event(
+            "audit/violation",
+            {
+                "pathway.audit.kind": "negative_multiplicity",
+                "pathway.operator": "groupby:3",
+                "pathway.key": "42",
+                "pathway.tick": 7,
+                "pathway.detail": "schema-coverage synthetic",
+            },
+        )
+        spans, _ = tracer.buffer.since(0, limit=100000)
+        captured["spans"] = spans
+        rt = pw.internals.run.current_runtime()
+        if rt is not None:
+            rt.request_stop()
+
+    th = threading.Thread(target=orchestrate)
+    th.start()
+    pw.run(monitoring_level="none")
+    th.join()
+    G.clear()
+
+    spans = captured["spans"]
+    assert spans, "no spans captured"
+    for s in spans:
+        validate_span(s)
+    names = {s["name"] for s in spans}
+    # coverage: every currently-emitted kind family must be present — a
+    # removed/renamed emitter fails here, not in a downstream collector
+    assert "tick" in names
+    assert any(n.startswith("sweep/") for n in names), names
+    assert any(n.startswith("sweep/chain{") for n in names), names
+    assert any(n.startswith("microbatch/") or n == "device/dispatch" for n in names), names
+    assert "serve/respond" in names, names
+    assert "audit/violation" in names
+    # request-plane spans (7-tuple records with per-request trace ids)
+    assert "request" in names and "serve/admission" in names, names
+    req_spans = [s for s in spans if s["name"] == "request"]
+    tick_spans = [s for s in spans if s["name"] == "tick"]
+    assert req_spans and tick_spans
+    # request spans carry their own (per-request) trace ids, tick spans the
+    # run trace id — distinct, both 32-hex (the stitching contract)
+    assert {s["traceId"] for s in req_spans}.isdisjoint(
+        {s["traceId"] for s in tick_spans}
+    )
+
+    # ---- the file sink's direct string serializer must agree ---------------
+    assert trace_file.exists(), "live OTLP file sink never wrote"
+    sink_spans: list[dict] = []
+    with open(trace_file) as fh:
+        for line in fh:
+            if line.strip():
+                sink_spans.extend(validate_export_line(line))
+    assert sink_spans
+    sink_names = {s["name"] for s in sink_spans}
+    assert "tick" in sink_names
+    assert "request" in sink_names, "request spans missing from the file sink"
+
+
+def test_request_plane_span_record_shapes():
+    """Unit: a synthetic request's kept spans validate without a pipeline
+    (every event family: boundary, engine stage with attrs, respond)."""
+    from pathway_tpu.internals.config import get_pathway_config
+
+    plane = req_mod.RequestTracePlane(get_pathway_config())
+    plane.slow_ms = 0.0  # keep unconditionally
+    now = time.time_ns()
+    key = 7777
+    plane.begin(key, "/v1/retrieve", now)
+    plane.note_tick(3)
+    plane.note_stage(3, "sweep/chain{select+subscribe}", now, now + 10_000, rows=4)
+    plane.note_stage(
+        3,
+        "microbatch/embed",
+        now,
+        now + 5_000,
+        rows=2,
+        attrs={"udf": "embed", "bucket": 8, "pad": 6, "cold": True, "compile_ms": 1.5},
+    )
+    plane.note_stage(3, "index/search", now, now + 2_000, rows=1)
+    doc = plane.complete(key, "ok", now + 20_000, now + 21_000)
+    assert doc is not None
+    assert doc["trace_id"] == req_mod.derive_request_trace_id(doc["request_id"])
+    for span in doc["spans"]:
+        validate_span(span)
+    names = [s["name"] for s in doc["spans"]]
+    assert names[0] == "request"
+    assert "microbatch/embed" in names and "index/search" in names
+    decomp = doc["decomposition_ms"]
+    assert decomp["index/search"] == pytest.approx(0.002)
+    assert decomp["serve/respond"] == pytest.approx(0.001)
