@@ -1,0 +1,385 @@
+"""Elasticity benchmark (ISSUE 14): reshard pause, bytes moved, pre/post-join
+throughput — the cost of changing a pod's shape mid-stream.
+
+Three legs:
+
+1. **input-log rebucket** — synthetic partitioned logs (3 workers, ``n``
+   events) re-owned to 2 workers by key range (``elastic.reshard_input_logs``):
+   seconds, rows/bytes moved, rows/s. Run ``reps`` times interleaved; the rep
+   spread feeds the noisy-host downgrade. This is the regression-gated metric
+   (``rebucket_rows_per_s``) — it is pure compute + backend I/O, the only leg
+   stable enough to gate on a shared host.
+2. **reshard pause** — an operator-persisted wordcount ingests ``n`` events at
+   2 workers; reopening the store is timed twice: at 2 workers (the r7
+   baseline recovery: snapshot restore + empty suffix) and at 3 workers with
+   ``PATHWAY_ELASTIC=manual`` (reshard-by-replay: shards dropped, full log
+   recomputed under the new shard map). The difference is what a rescale pays
+   over a plain restart.
+3. **supervised join** — the real subprocess cycle: a 2-process cluster
+   streams from a seekable broker, the driver requests ``scale --to 3``
+   mid-stream, and the Supervisor relaunches at 3. Pre/post-join throughput is
+   measured from OUTSIDE via the committed epoch manifests (offset growth per
+   second), join cycle time from the scale request to the new membership
+   commit, and the final net output is hard-gated against the ground truth
+   (zero lost or duplicated rows). NOTE: on this 2-core CPU host a third
+   process adds no real compute, so post/pre is reported for the record, not
+   gated — the gateable claim is correctness + cycle time, the speedup claim
+   belongs to multi-host pods (BASELINE §r17).
+
+Usage: ``python benchmarks/elasticity_bench.py [n_events] [--out BENCH_r17.json]``
+``BENCH_MODE=1`` turns gate failures into a non-zero exit (regression gate vs
+the last committed BENCH_r17.json, downgraded to a warning when the rep
+spread exceeds 1.6x — the r11 noisy-host discipline).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import pickle
+import shutil
+import socket
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from pathway_tpu import elastic  # noqa: E402
+from pathway_tpu.persistence.backends import FileBackend, MemoryBackend  # noqa: E402
+
+
+# ------------------------------------------------------------ leg 1: rebucket
+
+
+def _synth_logs(backend, n: int, workers: int) -> None:
+    per = n // workers
+    for w in range(workers):
+        pid = "src" if w == 0 else f"src@w{w}"
+        events = [(w * per + i, (f"payload-{w}-{i}",), 1) for i in range(per)]
+        backend.put(f"inputs/{pid}/chunk_{0:08d}", pickle.dumps(events))
+        backend.put(
+            f"inputs/{pid}/metadata",
+            pickle.dumps(
+                {
+                    "offset": per,
+                    "chunks": 1,
+                    "reader": None,
+                    "first_chunk": 0,
+                    "trimmed_events": 0,
+                    "chunk_sizes": [per],
+                }
+            ),
+        )
+
+
+def leg_rebucket(n: int, reps: int = 3) -> dict:
+    seconds = []
+    stats = None
+    for r in range(reps):
+        MemoryBackend.clear(f"ebench-{r}")
+        b = MemoryBackend(f"ebench-{r}")
+        _synth_logs(b, n, 3)
+        t0 = time.perf_counter()
+        stats = elastic.reshard_input_logs(b, 2)
+        seconds.append(time.perf_counter() - t0)
+    assert stats is not None and stats.rows_total == (n // 3) * 3
+    best = min(seconds)
+    spread = max(seconds) / max(min(seconds), 1e-9)
+    return {
+        "metric": "input_log_rebucket",
+        "events": stats.rows_total,
+        "rows_moved": stats.rows_moved,
+        "bytes_moved": stats.bytes_moved,
+        "seconds": round(best, 4),
+        "rebucket_rows_per_s": round(stats.rows_total / best, 1),
+        "rep_spread": round(spread, 2),
+        "moved_fraction_expected": round(elastic.moved_fraction(3, 2), 4),
+    }
+
+
+# -------------------------------------------------------- leg 2: reshard pause
+
+
+def _wordcount_session(broker_path: str, expected: int, pstore: str, workers: int) -> float:
+    import pathway_tpu as pw
+    from pathway_tpu.internals.parse_graph import G
+    from pathway_tpu.io.kafka import MockKafkaBroker
+
+    G.clear()
+    broker = MockKafkaBroker(path=broker_path)
+    words = pw.io.kafka.read(
+        broker, "words", format="plaintext", mode="streaming", name="words"
+    )
+    agg = words.groupby(words.data).reduce(words.data, c=pw.reducers.count())
+    total = agg.reduce(s=pw.reducers.sum(pw.this.c))
+
+    def on_total(key, row, time, is_addition):  # noqa: A002 - engine contract
+        if is_addition and row["s"] >= expected:
+            rt = pw.internals.run.current_runtime()
+            if rt is not None:
+                rt.request_stop()
+
+    pw.io.subscribe(total, on_change=on_total)
+    t0 = time.perf_counter()
+    pw.run(
+        monitoring_level="none",
+        n_workers=workers,
+        persistence_config=pw.persistence.Config(
+            backend=pw.persistence.Backend.filesystem(pstore),
+            persistence_mode="operator_persisting",
+            snapshot_interval_ms=500,
+        ),
+    )
+    return time.perf_counter() - t0
+
+
+def leg_reshard_pause(n: int, root: str) -> dict:
+    from pathway_tpu.io.kafka import MockKafkaBroker
+
+    os.environ["PATHWAY_ELASTIC"] = "manual"
+    try:
+        results = {}
+        for tag, workers2 in (("baseline_same_workers", 2), ("reshard_2_to_3", 3)):
+            broker_path = os.path.join(root, f"broker-{tag}")
+            pstore = os.path.join(root, f"pstore-{tag}")
+            shutil.rmtree(pstore, ignore_errors=True)
+            broker = MockKafkaBroker(path=broker_path)
+            broker.create_topic("words", partitions=2)
+            for i in range(n):
+                broker.produce("words", f"w{i % 997}", partition=i % 2)
+            _wordcount_session(broker_path, n, pstore, 2)
+            results[tag] = round(
+                _wordcount_session(broker_path, n, pstore, workers2), 3
+            )
+        return {
+            "metric": "reshard_pause",
+            "events": n,
+            "baseline_recovery_s": results["baseline_same_workers"],
+            "reshard_pause_s": results["reshard_2_to_3"],
+            # what the worker-count change itself costs over a plain restart
+            "reshard_overhead_s": round(
+                results["reshard_2_to_3"] - results["baseline_same_workers"], 3
+            ),
+        }
+    finally:
+        os.environ.pop("PATHWAY_ELASTIC", None)
+
+
+# ------------------------------------------------------ leg 3: supervised join
+
+_PIPELINE = """
+import os, sys
+sys.path.insert(0, os.environ["REPO"])
+import pathway_tpu as pw
+from pathway_tpu.io.kafka import MockKafkaBroker
+
+broker = MockKafkaBroker(path=os.environ["BROKER_PATH"])
+expected = int(os.environ["EXPECTED_WORDS"])
+words = pw.io.kafka.read(broker, "words", format="plaintext", mode="streaming", name="words")
+counts = words.groupby(words.data).reduce(words.data, c=pw.reducers.count())
+pw.io.fs.write(counts, os.environ["OUT_CSV"], format="csv")
+total = counts.reduce(s=pw.reducers.sum(pw.this.c))
+
+def on_total(key, row, time, is_addition):
+    if is_addition and row["s"] >= expected:
+        rt = pw.internals.run.current_runtime()
+        if rt is not None:
+            rt.request_stop()
+
+pw.io.subscribe(total, on_change=on_total)
+pw.run(monitoring_level="none",
+    persistence_config=pw.persistence.Config(
+        backend=pw.persistence.Backend.filesystem(os.environ["PATHWAY_PERSISTENT_STORAGE"]),
+        persistence_mode="operator_persisting", snapshot_interval_ms=200))
+"""
+
+
+def _free_port_base(n: int) -> int:
+    for base in range(31100, 60000, 127):
+        socks = []
+        try:
+            for p in range(base, base + n + 1):
+                s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+                s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+                s.bind(("127.0.0.1", p))
+                socks.append(s)
+            return base
+        except OSError:
+            continue
+        finally:
+            for s in socks:
+                s.close()
+    raise RuntimeError("no free port range")
+
+
+def leg_supervised_join(n: int, root: str) -> dict:
+    from pathway_tpu.io.kafka import MockKafkaBroker
+    from pathway_tpu.persistence.snapshots import read_epoch_manifest
+    from pathway_tpu.resilience import Supervisor
+
+    script = os.path.join(root, "pipe.py")
+    with open(script, "w") as fh:
+        fh.write(_PIPELINE)
+    broker_path = os.path.join(root, "broker")
+    pstore = os.path.join(root, "pstore")
+    out_csv = os.path.join(root, "out.csv")
+    broker = MockKafkaBroker(path=broker_path)
+    broker.create_topic("words", partitions=2)
+    # half up-front (pre-join phase), half after the join
+    first = [f"w{i % 997}" for i in range(n // 2)]
+    second = [f"x{i % 997}" for i in range(n - n // 2)]
+    for i, w in enumerate(first):
+        broker.produce("words", w, partition=i % 2)
+    env = dict(
+        os.environ,
+        REPO=REPO,
+        PYTHONPATH=REPO,
+        JAX_PLATFORMS="cpu",
+        BROKER_PATH=broker_path,
+        OUT_CSV=out_csv,
+        PATHWAY_PERSISTENT_STORAGE=pstore,
+        EXPECTED_WORDS=str(n),
+        PATHWAY_ELASTIC="manual",
+        PATHWAY_BARRIER_TIMEOUT="90",
+    )
+    backend = FileBackend(pstore)
+    marks: dict = {}
+
+    def offsets_sum() -> int:
+        ep = read_epoch_manifest(backend)
+        return sum(ep["input_offsets"].values()) if ep else 0
+
+    def measure_rate(tag: str, until: int, deadline_s: float) -> None:
+        t0, o0 = time.perf_counter(), offsets_sum()
+        deadline = t0 + deadline_s
+        while offsets_sum() < until and time.perf_counter() < deadline:
+            time.sleep(0.05)
+        t1, o1 = time.perf_counter(), offsets_sum()
+        if t1 > t0 and o1 > o0:
+            marks[tag] = round((o1 - o0) / (t1 - t0), 1)
+
+    def on_rescale(frm, to):
+        marks["membership_commit_t"] = time.perf_counter()
+        for i, w in enumerate(second):
+            broker.produce("words", w, partition=i % 2)
+
+    def driver():
+        # pre-join throughput over the first half's tail
+        measure_rate("pre_join_rows_per_s", len(first), 120)
+        marks["request_t"] = time.perf_counter()
+        elastic.write_scale_request(backend, 3)
+        while "membership_commit_t" not in marks:
+            time.sleep(0.05)
+        measure_rate("post_join_rows_per_s", n, 120)
+
+    th = threading.Thread(target=driver, daemon=True)
+    th.start()
+    sup = Supervisor(
+        [sys.executable, script],
+        processes=2,
+        threads=1,
+        first_port=_free_port_base(5),
+        max_restarts=1,
+        backoff_s=0.2,
+        env=env,
+        log_dir=os.path.join(root, "logs"),
+        on_rescale=on_rescale,
+    )
+    result = sup.run()
+    th.join(timeout=15)
+    # zero lost/duplicated output: net counts equal the ground truth
+    import csv as _csv
+
+    state: dict = {}
+    with open(out_csv) as fh:
+        for rec in _csv.DictReader(fh):
+            w, c, d = rec["data"], int(rec["c"]), int(rec["diff"])
+            state[w] = state.get(w, 0) + c * d
+            if state[w] == 0:
+                del state[w]
+    truth: dict = {}
+    for w in first + second:
+        truth[w] = truth.get(w, 0) + 1
+    m = elastic.read_membership(backend)
+    return {
+        "metric": "supervised_join",
+        "events": n,
+        "rescales": result.rescales,
+        "restarts": result.restarts,
+        "join_cycle_s": round(
+            marks.get("membership_commit_t", 0) - marks.get("request_t", 0), 3
+        ),
+        "pre_join_rows_per_s": marks.get("pre_join_rows_per_s"),
+        "post_join_rows_per_s": marks.get("post_join_rows_per_s"),
+        "membership_version": m.version if m else None,
+        "processes_after": m.processes if m else None,
+        "zero_loss": state == truth,
+    }
+
+
+# --------------------------------------------------------------------- driver
+
+
+def main() -> int:
+    n = int(sys.argv[1]) if len(sys.argv) > 1 and sys.argv[1].isdigit() else 60_000
+    out_path = None
+    if "--out" in sys.argv:
+        out_path = sys.argv[sys.argv.index("--out") + 1]
+    results: dict = {"bench": "elasticity", "n_events": n}
+    with tempfile.TemporaryDirectory() as root:
+        results["input_log_rebucket"] = leg_rebucket(n)
+        results["reshard_pause"] = leg_reshard_pause(min(n, 20_000), root)
+        results["supervised_join"] = leg_supervised_join(min(n // 10, 6_000), root)
+
+    noisy = results["input_log_rebucket"]["rep_spread"] > 1.6
+    failures: list[str] = []
+    # hard gates: correctness is never host-dependent
+    if not results["supervised_join"]["zero_loss"]:
+        failures.append("supervised join lost or duplicated output rows")
+    if results["supervised_join"]["rescales"] != 1:
+        failures.append(
+            f"expected exactly 1 rescale, saw {results['supervised_join']['rescales']}"
+        )
+    if results["input_log_rebucket"]["rows_moved"] <= 0:
+        failures.append("rebucket moved zero rows — the reshard did nothing")
+    # regression gate vs the last committed BENCH (noisy-host downgrade)
+    gate_warnings: list[str] = []
+    prev_path = os.path.join(REPO, "BENCH_r17.json")
+    if os.path.exists(prev_path):
+        with open(prev_path) as fh:
+            prev = json.load(fh)
+        prev_rate = (prev.get("input_log_rebucket") or {}).get("rebucket_rows_per_s")
+        rate = results["input_log_rebucket"]["rebucket_rows_per_s"]
+        if prev_rate and rate < 0.7 * prev_rate:
+            msg = (
+                f"rebucket_rows_per_s regressed: {rate} vs committed {prev_rate} "
+                f"(gate 0.7x)"
+            )
+            if noisy:
+                gate_warnings.append(msg + " — DOWNGRADED (rep spread > 1.6x)")
+            else:
+                failures.append(msg)
+    results["gate_failures"] = failures
+    results["gate_warnings"] = gate_warnings
+    results["gate_ok"] = not failures
+    doc = json.dumps(results, indent=2)
+    print(doc)
+    if out_path:
+        with open(out_path, "w") as fh:
+            fh.write(doc + "\n")
+    for w in gate_warnings:
+        print(f"WARNING: {w}", file=sys.stderr)
+    if failures and os.environ.get("BENCH_MODE") == "1":
+        print("gate failures (hard-fail under BENCH_MODE=1):", file=sys.stderr)
+        for f in failures:
+            print(f"  - {f}", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -152,6 +152,69 @@ def supervise(threads, processes, first_port, max_restarts, backoff, log_dir, pr
 
 
 @cli.command()
+@click.option("--to", "target", type=int, required=True, help="target process count")
+@click.option(
+    "--storage",
+    type=str,
+    default=None,
+    help="persistence root holding the cluster's membership table "
+    "(default PATHWAY_PERSISTENT_STORAGE)",
+)
+@click.option("--host", type=str, default=None, help="instead of the storage path, hit a RUNNING coordinator's monitoring server")
+@click.option(
+    "--port",
+    type=int,
+    default=None,
+    help="monitoring server port (default PATHWAY_MONITORING_HTTP_PORT, 20000)",
+)
+def scale(target, storage, host, port):
+    """Request a live rescale of a running elastic cluster to TARGET
+    processes (``PATHWAY_ELASTIC=manual`` or ``auto``). Default transport is
+    the persistence backend: the request lands in ``elastic/scale_request``
+    and the coordinator adopts it on its next tick-continuation barrier; the
+    pod then quiesces to one final committed checkpoint epoch and its
+    Supervisor relaunches it at the new shape, state resharded by key range.
+    With ``--host``, the request goes to the coordinator's monitoring server
+    ``/scale`` endpoint instead (no filesystem access needed)."""
+    if target < 1:
+        raise click.UsageError(f"--to must be >= 1, got {target}")
+    if host is not None:
+        import json as _json
+        import urllib.request
+
+        if port is None:
+            port = int(os.environ.get("PATHWAY_MONITORING_HTTP_PORT", "20000"))
+        url = f"http://{host}:{port}/scale?to={target}"
+        try:
+            body = urllib.request.urlopen(url, timeout=5).read().decode()
+        except OSError as e:
+            raise click.ClickException(
+                f"cannot reach monitoring server at {host}:{port}: {e} "
+                "(is the pipeline running with with_http_server=True?)"
+            ) from e
+        doc = _json.loads(body)
+        click.echo(_json.dumps(doc, indent=2))
+        if doc.get("ok") is False:
+            raise click.ClickException(doc.get("error", "scale request failed"))
+        return
+    storage = storage or get_pathway_config().persistent_storage
+    if not storage:
+        raise click.UsageError(
+            "no persistence root: pass --storage or set PATHWAY_PERSISTENT_STORAGE "
+            "(or use --host to reach a running coordinator)"
+        )
+    from pathway_tpu.elastic import write_scale_request
+    from pathway_tpu.persistence.backends import FileBackend
+
+    req = write_scale_request(FileBackend(storage), target, source="cli")
+    click.echo(
+        f"scale request to {target} process(es) recorded at {storage!r} "
+        f"(requested_unix {req['requested_unix']:.3f}); the coordinator "
+        "adopts it within a tick"
+    )
+
+
+@cli.command()
 @click.option("--host", type=str, default="127.0.0.1", help="monitoring server host")
 @click.option(
     "--port",

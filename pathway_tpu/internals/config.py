@@ -90,6 +90,73 @@ class PathwayConfig:
     def supervisor_backoff_s(self) -> float:
         return _env_float("PATHWAY_SUPERVISOR_BACKOFF", 0.5)
 
+    # ---- elasticity (live scale-out / scale-in) -----------------------------
+    @property
+    def elastic(self) -> str:
+        """Elasticity plane master switch: ``off`` (default — the pre-r17
+        fixed-worker behavior, byte for byte), ``manual`` (the coordinator
+        honors ``pathway_tpu scale --to N`` requests: the pod quiesces to the
+        next committed checkpoint epoch, commits a new membership version and
+        exits with the rescale status so a Supervisor relaunches it at the new
+        shape, state resharding by key range from the committed epoch), or
+        ``auto`` (additionally the pressure-driven autoscaler decides joins
+        and drains from the r9 pod-pressure signal + sink p99 vs SLO)."""
+        raw = os.environ.get("PATHWAY_ELASTIC", "off").strip().lower()
+        if raw in ("", "0", "false", "no", "off"):
+            return "off"
+        if raw not in ("manual", "auto"):
+            raise ValueError(
+                f"PATHWAY_ELASTIC must be off/manual/auto, got {raw!r}"
+            )
+        return raw
+
+    @property
+    def elastic_min_processes(self) -> int:
+        """Autoscaler lower bound: drains never shrink the pod below this."""
+        return max(1, _env_int("PATHWAY_ELASTIC_MIN_PROCESSES", 1))
+
+    @property
+    def elastic_max_processes(self) -> int:
+        """Autoscaler upper bound: joins never grow the pod past this."""
+        return max(1, _env_int("PATHWAY_ELASTIC_MAX_PROCESSES", 8))
+
+    @property
+    def elastic_high_pressure(self) -> float:
+        """Pod-pressure level treated as saturation: sustained readings at or
+        above it (see ``PATHWAY_ELASTIC_SUSTAIN_TICKS``) trigger a join."""
+        v = _env_float("PATHWAY_ELASTIC_HIGH_PRESSURE", 0.75)
+        if not 0.0 < v <= 1.0:
+            raise ValueError(
+                f"PATHWAY_ELASTIC_HIGH_PRESSURE must be in (0, 1], got {v}"
+            )
+        return v
+
+    @property
+    def elastic_low_pressure(self) -> float:
+        """Pod-pressure level treated as idle: sustained readings at or below
+        it trigger a drain. Must sit below the high threshold (hysteresis —
+        the band between them is the no-decision zone)."""
+        v = _env_float("PATHWAY_ELASTIC_LOW_PRESSURE", 0.05)
+        if not 0.0 <= v < 1.0:
+            raise ValueError(
+                f"PATHWAY_ELASTIC_LOW_PRESSURE must be in [0, 1), got {v}"
+            )
+        return v
+
+    @property
+    def elastic_sustain_ticks(self) -> int:
+        """Consecutive ticks a pressure reading must hold beyond a threshold
+        before the autoscaler acts — one flooded tick is noise, a sustained
+        run is a trend."""
+        return max(1, _env_int("PATHWAY_ELASTIC_SUSTAIN_TICKS", 50))
+
+    @property
+    def elastic_cooldown_s(self) -> float:
+        """Seconds after any scale decision during which no further decision
+        fires — the relaunched pod needs time to warm before its pressure
+        readings mean anything."""
+        return max(0.0, _env_float("PATHWAY_ELASTIC_COOLDOWN", 30.0))
+
     # ---- persistence / replay ----------------------------------------------
     @property
     def persistent_storage(self) -> str | None:
@@ -652,6 +719,13 @@ class PathwayConfig:
                 "heartbeat_interval",
                 "heartbeat_timeout",
                 "fault_plan",
+                "elastic",
+                "elastic_min_processes",
+                "elastic_max_processes",
+                "elastic_high_pressure",
+                "elastic_low_pressure",
+                "elastic_sustain_ticks",
+                "elastic_cooldown_s",
                 "persistent_storage",
                 "replay_storage",
                 "replay_mode",

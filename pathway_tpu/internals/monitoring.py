@@ -175,6 +175,13 @@ def run_stats(runtime) -> dict[str, Any]:
     cluster = _obs.aggregate.cluster_status(runtime)
     if cluster is not None:
         stats["cluster"] = cluster
+    # elasticity plane (PATHWAY_ELASTIC): membership version/shape, pending
+    # scale decisions, autoscaler streaks and the last reshard's movement
+    from pathway_tpu import elastic as _elastic
+
+    elastic = _elastic.status(runtime)
+    if elastic is not None:
+        stats["elastic"] = elastic
     return stats
 
 
@@ -317,6 +324,10 @@ def prometheus_text(runtime) -> str:
     aud = _obs.audit.current()
     if aud is not None:
         lines.extend(aud.prometheus_lines(runtime))
+    # ---- elasticity plane (membership + reshard movement) -------------------
+    from pathway_tpu import elastic as _elastic
+
+    lines.extend(_elastic.prometheus_lines(runtime))
     # ---- per-operator row-level error counters ------------------------------
     from pathway_tpu.internals import error_log as _error_log
 
@@ -411,6 +422,41 @@ def _trace_payload(query: str) -> bytes:
     return json.dumps(doc).encode()
 
 
+def _scale_payload(runtime, query: str) -> bytes:
+    """``/scale?to=N``: hand a manual rescale request to the live elasticity
+    plane (the HTTP twin of ``pathway_tpu scale`` writing to the backend).
+    Without ``to=``, reports the plane's current status instead."""
+    from urllib.parse import parse_qs
+
+    from pathway_tpu import elastic as _elastic
+
+    plane = _elastic.current()
+    if plane is None:
+        from pathway_tpu.internals.config import get_pathway_config
+
+        if get_pathway_config().elastic == "off":
+            err = "elasticity is off (PATHWAY_ELASTIC=off)"
+        else:
+            # configured on, but no plane installed: scale decisions ride the
+            # cluster continuation barrier — single/thread-sharded runtimes
+            # don't run one
+            err = (
+                "the elasticity plane is not active on this runtime "
+                "(decisions ride the cluster continuation barrier; run with "
+                "PATHWAY_PROCESSES > 1 under a Supervisor)"
+            )
+        return json.dumps({"ok": False, "error": err}).encode()
+    qs = parse_qs(query)
+    if not qs.get("to"):
+        return json.dumps({"ok": True, "elastic": plane.status()}).encode()
+    try:
+        target = int(qs["to"][0])
+        doc = plane.request_scale(target, source="http")
+    except ValueError as e:
+        return json.dumps({"ok": False, "error": str(e)}).encode()
+    return json.dumps(doc).encode()
+
+
 def _request_payload(query: str) -> bytes:
     """``/request?id=<request_id>``: one request's kept flight-path trace
     (OTLP spans + per-stage latency decomposition), or its in-flight status.
@@ -482,6 +528,9 @@ class MonitoringHttpServer:
                     ctype = "application/json"
                 elif path.rstrip("/") == "/request":
                     body = _request_payload(query)
+                    ctype = "application/json"
+                elif path.rstrip("/") == "/scale":
+                    body = _scale_payload(rt, query)
                     ctype = "application/json"
                 else:
                     self.send_response(404)

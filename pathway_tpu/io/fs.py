@@ -379,6 +379,53 @@ def _write_sharded(
         for _w, p in parts:
             os.remove(p)
 
+    def _check_stale_parts(n: int) -> None:
+        """Part files from a previous run under a DIFFERENT worker count
+        (elasticity satellite): ``part-<w>`` for w outside the current worker
+        set would silently survive as stale output next to the live parts.
+        Formatted part rows carry no keys, so remapping them by key range is
+        impossible from the files alone — with the elasticity plane enabled
+        the stale parts are removed (every row regenerates from the replayed
+        input logs, re-routed by the new shard map); otherwise fail with a
+        clear error naming the mismatch."""
+        import glob as _glob
+
+        stale = []
+        # escape the sink path: a filename with glob metacharacters must not
+        # silently disable the detection this guard exists for
+        for p in _glob.glob(_glob.escape(filename) + ".part-*"):
+            suffix = p.rsplit(".part-", 1)[1]
+            if suffix.isdigit() and int(suffix) >= n:
+                stale.append(p)
+        if not stale:
+            return
+        from pathway_tpu import elastic as _elastic
+        from pathway_tpu.internals.telemetry import record_event
+
+        if _elastic.reshard_enabled():
+            for p in stale:
+                try:
+                    os.remove(p)
+                except FileNotFoundError:
+                    pass  # another cluster process won the race
+            record_event(
+                "elastic.sink_parts_remapped",
+                sink=filename,
+                removed=len(stale),
+                n_workers=n,
+            )
+            return
+        old_n = max(int(p.rsplit(".part-", 1)[1]) for p in stale) + 1
+        raise RuntimeError(
+            f"fs.write(sharded=True) restore: found part file(s) "
+            f"{sorted(os.path.basename(p) for p in stale)} from a run with "
+            f"at least {old_n} workers, but this run has {n}; part rows "
+            "carry no keys so they cannot be remapped by key range — "
+            "restart with the original worker count, remove the stale parts "
+            "and the persistence storage, or enable PATHWAY_ELASTIC to "
+            "rebuild every part from the replayed input logs"
+        )
+
     def factory() -> Node:
         from pathway_tpu.internals.logical import current_build
 
@@ -389,6 +436,9 @@ def _write_sharded(
         with lock:
             state["parts"][w] = part_path
             state["n_workers"] = max(state["n_workers"], n)
+            if not state.get("stale_checked"):
+                state["stale_checked"] = True
+                _check_stale_parts(n)
         # LAZY open (same rule as the solo writer): opening "w" at graph build
         # would truncate a previous run's part BEFORE restore_sink can rewind
         # it to the snapshot cut

@@ -48,6 +48,14 @@ def local_summary(runtime) -> dict[str, Any]:
         "resilience": resilience_summary(),
         "ts_unix": round(_time.time(), 3),
     }
+    # elasticity plane: stamp the sender's membership version so the
+    # coordinator can reject summaries from before the last reshard (a
+    # retired process's final heartbeat racing the membership commit)
+    from pathway_tpu import elastic as _elastic
+
+    eplane = _elastic.current()
+    if eplane is not None and eplane.membership is not None:
+        summary["membership_version"] = eplane.membership.version
     # flow plane: gate occupancy rides the heartbeat so the coordinator can
     # merge a pod-wide pressure (credit piggyback — no new sockets)
     from pathway_tpu import flow as _flow

@@ -458,6 +458,10 @@ class ClusterRuntime:
         self.persistence: Any = None
         self.on_tick_done: list[Any] = []
         self._stop_requested = False
+        # elasticity plane (PATHWAY_ELASTIC): set when the continuation
+        # barrier broadcast carries a rescale decision — the pod quiesces to
+        # one final committed epoch and exits with the rescale status
+        self._rescale_decision: dict | None = None
         self.streaming = False  # set after build (see engine.runtime.Runtime)
         self.current_time = 0
         # arrival-driven tick scheduling: the coordinator (pid 0) owns the
@@ -1023,12 +1027,26 @@ class ClusterRuntime:
 
     # ---------------------------------------------------------------- run loop
     def run(self, outputs: list[LogicalNode]):
+        from pathway_tpu import elastic as _elastic
         from pathway_tpu import flow as _flow
         from pathway_tpu import observability as _obs
 
         _faults.install_from_env()
         _obs.install_from_env(self)
         _flow.install_from_env(self)  # before build: gates attach to inputs
+        # after persistence attach (pw.run order), so the plane finds the
+        # backend the membership table lives in
+        _elastic.install_from_env(self)
+        eplane = _elastic.current()
+        if (
+            eplane is not None
+            and eplane.membership is not None
+            and self.hb_monitor is not None
+        ):
+            # stale-membership guard: heartbeat summaries stamped with an
+            # older membership version (a retired process's last gasp) are
+            # rejected instead of polluting the coordinator's merged state
+            self.hb_monitor.set_membership_version(eplane.membership.version)
         self.tracer = _obs.current()
         if self.hb_client is not None:
             # telemetry summaries ride the existing heartbeat messages, so the
@@ -1041,20 +1059,25 @@ class ClusterRuntime:
             # flight-recorder post-mortem: on an OtherWorkerError the dump
             # names the dead peer and its last known tick (the survivors are
             # where the post-mortem evidence lives — the dead process wrote
-            # nothing)
-            _obs.device.on_run_error(e, self)
+            # nothing). A ClusterRescale is a coordinated exit, not a
+            # failure — no post-mortem.
+            if not isinstance(e, _elastic.ClusterRescale):
+                _obs.device.on_run_error(e, self)
             raise
         finally:
             self.tracer = None
             _obs.shutdown()
             _flow.shutdown()
+            _elastic.shutdown()
 
     def _run_inner(self, outputs: list[LogicalNode]):
+        from pathway_tpu import elastic as _elastic
         from pathway_tpu import flow as _flow
 
         self._build(outputs)
         self.streaming = bool(self.connectors)
         plane = _flow.current()
+        eplane = _elastic.current()
         if plane is not None:
             self.on_tick_done.append(lambda t: plane.on_tick_complete(self, t))
         if self.pid == 0:
@@ -1100,7 +1123,7 @@ class ClusterRuntime:
                         getattr(d, "virtual", False) for d in self.connectors
                     )
 
-                    def decide(reports):
+                    def decide(reports, _tick=tick):
                         d = {
                             "done": any(r[2] for r in reports)
                             or all(r[1] for r in reports)
@@ -1112,6 +1135,19 @@ class ClusterRuntime:
                             # continue decision — a slow peer throttles every
                             # producer instead of OOMing one host
                             d["flow"] = plane.cluster_signal(self._peer_flows())
+                        if eplane is not None and not d["done"]:
+                            # elasticity: manual scale requests + the
+                            # autoscaler consult here, fed the SAME merged
+                            # pod pressure the flow broadcast carries; a
+                            # decision rides the continue verdict so every
+                            # process quiesces at the same tick boundary
+                            resc = eplane.maybe_decide(
+                                self,
+                                _tick,
+                                (d.get("flow") or {}).get("pressure"),
+                            )
+                            if resc is not None:
+                                d["rescale"] = resc
                         return d
 
                     decision = self.coord.barrier(report, decide)
@@ -1120,7 +1156,10 @@ class ClusterRuntime:
                     all_virtual = True
                 if plane is not None:
                     plane.apply_cluster_signal(decision.get("flow"))
-                if decision["done"]:
+                resc = decision.get("rescale")
+                if resc is not None:
+                    self._rescale_decision = resc
+                if decision["done"] or resc is not None:
                     self.run_tick(tick)  # drain final events
                     break
                 if self.pid == 0 and self.connectors and not all_virtual:
@@ -1136,6 +1175,18 @@ class ClusterRuntime:
 
         check_connector_failures(self.connectors)
         self.close()
+        if self._rescale_decision is not None:
+            # the pod is quiesced and its final epoch is committed (close()
+            # ran the coordinated at-close snapshot): publish the new
+            # membership version and leave with the rescale status so a
+            # Supervisor relaunches the cluster at the new shape
+            if eplane is not None:
+                eplane.finalize_rescale(self, self._rescale_decision)
+            raise _elastic.ClusterRescale(  # peers without a plane still exit 75
+                int(self._rescale_decision["target"]),
+                int(self._rescale_decision["version"]),
+                str(self._rescale_decision["reason"]),
+            )
         return self
 
     def close(self) -> None:

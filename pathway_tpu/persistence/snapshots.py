@@ -75,6 +75,7 @@ class _PersistedInput:
         self.first_chunk = 0  # chunks below this were compacted away
         self.trimmed_events = 0  # events contained in compacted chunks
         self.chunk_sizes: list[int] = []  # sizes of chunks [first_chunk, n_chunks)
+        self.resharded = False  # log was key-range rebucketed by a rescale
         self._load_metadata()
         self.persisted = self.stored_offset
         # operator snapshots: state already covers this absolute log prefix
@@ -83,6 +84,25 @@ class _PersistedInput:
             if self.reader_state is not None:
                 subject.seek(self.reader_state)
             self.stored_offset = 0  # seek replaces the prefix-drop entirely
+        elif self.resharded and self.stored_offset:
+            # the rebucketed log holds a KEY-RANGE slice; the subject's live
+            # slice follows its own (changed) partition map, so the
+            # count-based prefix-drop would discard never-logged rows.
+            # Disable it — at-least-once across this one edge (replayed rows
+            # the subject re-produces may duplicate), matching the
+            # seek-state-dropped posture and WARNED, never silent
+            from pathway_tpu.internals.telemetry import record_event
+
+            record_event("elastic.reshard_prefix_drop_disabled", source=self.pid)
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "elastic reshard: input log %r was re-bucketed by key range; "
+                "the live prefix-drop is disabled for this non-seekable "
+                "partitioned source (at-least-once across the rescale)",
+                self.pid,
+            )
+            self.stored_offset = 0
         self._install()
 
     # -- storage ------------------------------------------------------------
@@ -99,6 +119,7 @@ class _PersistedInput:
             self.first_chunk = meta.get("first_chunk", 0)
             self.trimmed_events = meta.get("trimmed_events", 0)
             self.chunk_sizes = meta.get("chunk_sizes", [])
+            self.resharded = meta.get("resharded", False)
             if len(self.chunk_sizes) != self.n_chunks - self.first_chunk:
                 # metadata predates size tracking: reconstruct from the chunks
                 # themselves so trim() never mis-accounts legacy storage
@@ -517,6 +538,9 @@ class Persistence:
         self._is_cluster = False
         self._pid = 0
         self._total_workers = 1
+        #: (stored workers, current workers) when this restore resharded by
+        #: replay instead of restoring positional shards (PATHWAY_ELASTIC)
+        self._reshard_restore: tuple[int, int] | None = None
 
     # called by Runtime once the engine graph is built, before drivers start
     def on_graph_built(self, ctx) -> None:
@@ -530,10 +554,27 @@ class Persistence:
             self._is_cluster = True
             self._pid = self.runtime.pid
             self._total_workers = self.runtime.n_workers
+        else:
+            # thread-sharded runtimes: the worker count must be known BEFORE
+            # the elastic input-log scan below — with the 1-worker default a
+            # same-shape restart would misread every @w partition log as
+            # orphaned and rebucket (duplicating) perfectly healthy history
+            workers = getattr(self.runtime, "workers", None)
+            if workers:
+                self._total_workers = len(workers)
         if self._pid == 0:
             # single-writer plane: only process 0 (or the solo runtime)
             # commits epoch manifests; peers report durability over the barrier
             self.epochs = _EpochLog(self.backend)
+        # elasticity (PATHWAY_ELASTIC != off): partitioned input logs owned by
+        # workers the new shape no longer has would otherwise never replay —
+        # re-bucket them across the new worker set by key range BEFORE any
+        # input wrapping reads them. Single writer (process 0 / the solo
+        # runtime); cluster peers wait on a barrier.
+        from pathway_tpu import elastic as _elastic
+
+        if _elastic.reshard_enabled():
+            self._elastic_reshard_inputs()
         if self.operator_mode:
             # worker shards keyed by GLOBAL worker index: the single runtime is
             # {0: nodes}, the thread-sharded runtime {0..W-1}, and a cluster
@@ -577,16 +618,6 @@ class Persistence:
                 self.backend, self.config.snapshot_interval_ms / 1000.0
             )
             if self.opsnap.manifest is not None:
-                if self.opsnap.stored_workers() != self._total_workers:
-                    # state shards are positional per worker; resharding them
-                    # on restart is future work — refuse loudly (compaction
-                    # already dropped the log prefix, so recompute is impossible)
-                    raise RuntimeError(
-                        "operator_persisting: persisted snapshots were taken "
-                        f"with {self.opsnap.stored_workers()} worker(s) but "
-                        f"this run has {self._total_workers}; restart with "
-                        "the same worker count or clear the persistence storage"
-                    )
                 if not self.opsnap.validate(self._node_names):
                     # operator snapshots are positional AND compaction already
                     # dropped the consumed log prefix — a different graph can
@@ -599,8 +630,11 @@ class Persistence:
                         f"current {self._node_names}); clear the persistence "
                         "storage or revert the pipeline change"
                     )
-                offsets = dict(self.opsnap.manifest["input_offsets"])
-                self.opsnap.restore(self._worker_nodes)
+                if self.opsnap.stored_workers() != self._total_workers:
+                    self._elastic_reshard_opsnap()
+                else:
+                    offsets = dict(self.opsnap.manifest["input_offsets"])
+                    self.opsnap.restore(self._worker_nodes)
         if self._is_cluster and self._pid != 0:
             # non-partitioned sources poll only on process 0; partitioned
             # sources (r5) DO live on peer processes — persist those locally
@@ -666,11 +700,83 @@ class Persistence:
                     )
         self._replay_all()
 
+    def _elastic_reshard_inputs(self) -> None:
+        """Re-own orphaned partitioned input logs under the new worker count
+        (elasticity plane). Runs on every restore while PATHWAY_ELASTIC is
+        enabled; a no-op scan when the layout already matches."""
+        from pathway_tpu import elastic as _elastic
+
+        if self._pid == 0:
+            orphans = _elastic.orphan_workers(self.backend, self._total_workers)
+            if orphans:
+                old = max(max(v) for v in orphans.values()) + 1
+                stats = _elastic.reshard_input_logs(self.backend, self._total_workers)
+                _elastic.note_reshard_restore(old, self._total_workers, stats)
+        if self._is_cluster:
+            # peers must not wrap inputs until the coordinator's rebucket is
+            # durable; symmetric barrier (reshard_enabled is env-driven, so
+            # every process takes this path or none does)
+            self.runtime._barrier(
+                ("elastic_reshard", self._pid, {}), lambda reports: {"ok": True}
+            )
+
+    def _elastic_reshard_opsnap(self) -> None:
+        """Worker count changed under operator persistence: positional shards
+        cannot restore into a different worker set. With PATHWAY_ELASTIC
+        enabled, reshard by replay — drop the shards and let the (untrimmed;
+        elastic mode suspends log compaction) input logs recompute every
+        operator's state, each replayed row re-routed by the new shard map.
+        Without it, refuse loudly (the pre-r17 contract)."""
+        from pathway_tpu import elastic as _elastic
+
+        stored = self.opsnap.stored_workers()
+        if not _elastic.reshard_enabled():
+            raise RuntimeError(
+                "operator_persisting: persisted snapshots were taken "
+                f"with {stored} worker(s) but "
+                f"this run has {self._total_workers}; restart with "
+                "the same worker count, clear the persistence storage, or "
+                "enable PATHWAY_ELASTIC to reshard by key range from the "
+                "replayed input logs"
+            )
+        self._reshard_restore = (stored, self._total_workers)
+        _elastic.note_reshard_restore(stored, self._total_workers)
+        # the dropped shards (all generations, all old workers' aux chunk
+        # sets) will never be read again — reclaim them now, single writer,
+        # then every process re-inits its generation bookkeeping from the
+        # now-empty manifest so generation numbers stay aligned pod-wide
+        if self._pid == 0:
+            for k in self.backend.list_keys("operators/"):
+                self.backend.delete(k)
+        if self._is_cluster:
+            self.runtime._barrier(
+                ("elastic_opsnap_reset", self._pid, {}), lambda reports: {"ok": True}
+            )
+        self.opsnap = _OperatorSnapshots(
+            self.backend, self.config.snapshot_interval_ms / 1000.0
+        )
+
     def _replay_all(self) -> None:
         """Replay every persisted input, recording the O(suffix) cost: a run
         recovering from operator snapshots replays only the log tail past the
         committed offsets, and the telemetry gauge lets tests (and operators)
         assert recovery was NOT a full-history recompute."""
+        if self._reshard_restore is not None:
+            # reshard-by-replay needs the FULL history: a log whose prefix was
+            # compacted (pre-elastic storage) cannot recompute the dropped
+            # operator shards — fail naming the source, never silently lose
+            # its prefix
+            for p in self.inputs:
+                if p.trimmed_events > 0:
+                    raise RuntimeError(
+                        f"elastic reshard: input log {p.pid!r} had "
+                        f"{p.trimmed_events} leading event(s) compacted away "
+                        "under a previous non-elastic run, so operator state "
+                        "cannot be recomputed for the new worker count; "
+                        "restart with the original "
+                        f"{self._reshard_restore[0]} worker(s) or clear the "
+                        "persistence storage"
+                    )
         if any(getattr(p, "replay_skip", 0) > 0 for p in self.inputs):
             # suffix-only replay: the stream prefix is invisible to this run,
             # so the audit plane's history-dependent monitors (multiplicity,
@@ -754,8 +860,19 @@ class Persistence:
         self.opsnap.save(self._worker_nodes, self._node_names, offsets, time)
         if self.epochs is not None:
             self.epochs.commit(time, offsets, opsnap_gen=gen, force=True)
+        self._trim_inputs(lambda p: offsets[p.pid])
+
+    def _trim_inputs(self, offset_of) -> None:
+        """Log compaction after a durable operator commit — SUSPENDED while
+        the elasticity plane is enabled: reshard-by-replay needs the full
+        history to recompute state for a new worker count, so elastic runs
+        trade compaction for reshardability (README "Elasticity")."""
+        from pathway_tpu import elastic as _elastic
+
+        if _elastic.reshard_enabled():
+            return
         for p in self.inputs:
-            p.trim(offsets[p.pid])
+            p.trim(offset_of(p))
 
     @staticmethod
     def _merge_offsets(reports):
@@ -802,8 +919,7 @@ class Persistence:
         self.runtime._barrier(
             ("commit_done", self._pid, {}), lambda reports: {"ok": True}
         )
-        for p in self.inputs:
-            p.trim(decision["offsets"].get(p.pid, 0))
+        self._trim_inputs(lambda p: decision["offsets"].get(p.pid, 0))
         self.opsnap.flush_aux_gc()  # each process GCs its own shards' chunks
         self.opsnap.advance()
 

@@ -85,6 +85,14 @@ class HeartbeatMonitor:
         self._lock = threading.Lock()
         self._peers: dict[int, _PeerState] = {}
         self._reported: set[int] = set()
+        # elasticity hardening: peers retired by a drain decision — late
+        # messages from them are dropped (warned once), never treated as
+        # state or death
+        self._retired: set[int] = set()
+        self._stale_warned: set[int] = set()
+        #: current membership version (elastic plane); summaries stamped with
+        #: an older version are rejected as coming from before the reshard
+        self.membership_version: int | None = None
         self._conns: list[socket.socket] = []
         self._closed = False
         self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
@@ -116,14 +124,24 @@ class HeartbeatMonitor:
                 summary = msg[3] if len(msg) > 3 else None
                 if pid is None:
                     pid = int(peer)
-                    with self._lock:
-                        self._peers.setdefault(pid, _PeerState())
                 with self._lock:
-                    st = self._peers[pid]
+                    if pid in self._retired:
+                        # a just-retired peer's in-flight message: drop it
+                        # with one structured warning — it must neither
+                        # resurrect the peer state nor read as a death
+                        if pid not in self._stale_warned:
+                            self._stale_warned.add(pid)
+                            record_event(
+                                "elastic.stale_peer_message",
+                                process_id=pid,
+                                message_kind=str(kind),
+                            )
+                        return
+                    st = self._peers.setdefault(pid, _PeerState())
                     st.last_seen = _time.monotonic()
                     if tick is not None:
                         st.tick = int(tick)
-                    if summary is not None:
+                    if summary is not None and self._summary_current(pid, summary):
                         st.summary = summary
                     if kind == "bye":
                         st.clean = True
@@ -142,6 +160,36 @@ class HeartbeatMonitor:
             except OSError:
                 pass
 
+    def _summary_current(self, pid: int, summary: dict) -> bool:
+        """Reject summaries stamped with a membership version older than the
+        coordinator's (the sender predates the last reshard). Structured
+        warning once per (peer, version); liveness is unaffected — only the
+        telemetry payload is dropped. Caller holds ``self._lock``."""
+        if self.membership_version is None or not isinstance(summary, dict):
+            return True
+        from pathway_tpu.elastic.membership import check_version
+
+        return check_version(
+            self.membership_version,
+            summary.get("membership_version"),
+            f"heartbeat:p{pid}",
+        )
+
+    def set_membership_version(self, version: int) -> None:
+        with self._lock:
+            self.membership_version = version
+
+    def retire_peer(self, pid: int) -> None:
+        """Elasticity drain: remove a peer from the failure detector and the
+        flow merge — its clean (or abrupt) departure is expected, and its
+        queue occupancy must stop scaling the pod's credit."""
+        with self._lock:
+            self._retired.add(pid)
+            existed = self._peers.pop(pid, None) is not None
+            self._reported.discard(pid)
+        if existed:
+            record_event("elastic.peer_retired", process_id=pid)
+
     def seen_peers(self) -> dict[int, int | None]:
         """pid → last-known tick, for every peer that ever connected."""
         with self._lock:
@@ -159,11 +207,14 @@ class HeartbeatMonitor:
         peer's heartbeats ({} until one arrives). The coordinator merges these
         into the pod-wide pressure it broadcasts on the tick barrier, which is
         what makes backpressure CLUSTER-wide: a peer whose ingest queues fill
-        shrinks every process's effective credit."""
+        shrinks every process's effective credit. Drained peers (clean
+        goodbye or retired by an elastic drain) drop out: a gone process's
+        stale occupancy must not keep throttling the survivors."""
         with self._lock:
             return {
                 pid: (st.summary or {}).get("flow") or {}
                 for pid, st in self._peers.items()
+                if not st.clean and not st.eof
             }
 
     def dead_peer(self) -> tuple[int, int | None, str] | None:

@@ -39,11 +39,18 @@ class SupervisorGaveUp(RuntimeError):
         self.attempts = attempts
 
 
+#: exit status of a coordinated elastic rescale (``elastic.RESCALE_EXIT_CODE``
+#: — duplicated here so the supervisor has no import-order coupling with the
+#: plane it relaunches; an assertion in tests pins the two together)
+RESCALE_EXIT_CODE = 75
+
+
 @dataclass
 class SupervisorResult:
     restarts: int
     attempts: list[dict] = field(default_factory=list)
     log_paths: list[str] = field(default_factory=list)
+    rescales: int = 0
 
 
 class Supervisor:
@@ -73,6 +80,8 @@ class Supervisor:
         poll_interval: float = 0.05,
         term_grace_s: float = 5.0,
         on_restart: Callable[[int, list[int | None]], Any] | None = None,
+        storage: str | None = None,
+        on_rescale: Callable[[int, int], Any] | None = None,
     ):
         cfg = get_pathway_config()
         self.program = list(program)
@@ -92,7 +101,15 @@ class Supervisor:
         self.poll_interval = poll_interval
         self.term_grace_s = term_grace_s
         self.on_restart = on_restart
+        #: persistence root holding the elastic membership table (defaults to
+        #: the child env's PATHWAY_PERSISTENT_STORAGE) — read when an attempt
+        #: exits with the rescale status to learn the new process count
+        self.storage = storage if storage is not None else self.env.get(
+            "PATHWAY_PERSISTENT_STORAGE"
+        )
+        self.on_rescale = on_rescale
         self.restarts = 0
+        self.rescales = 0
         self.attempts: list[dict] = []
 
     # -- internals ------------------------------------------------------------
@@ -136,10 +153,17 @@ class Supervisor:
         terminate the survivors (TERM, grace, KILL). Returns (final exit
         codes, processes that failed ON THEIR OWN) — the failed set is
         captured BEFORE the teardown, so survivors the supervisor itself
-        SIGTERMs are not misreported as the cause."""
+        SIGTERMs are not misreported as the cause.
+
+        ``RESCALE_EXIT_CODE`` is not a failure: it is the coordinated
+        elastic-rescale status every process adopts at the same barrier, so
+        the loop simply waits for the stragglers (they are finishing the same
+        quiesce) and returns clean."""
         while True:
             codes = [p.poll() for p in procs]
-            failed = [i for i, c in enumerate(codes) if c not in (None, 0)]
+            failed = [
+                i for i, c in enumerate(codes) if c not in (None, 0, RESCALE_EXIT_CODE)
+            ]
             if failed:
                 for p in procs:
                     if p.poll() is None:
@@ -154,7 +178,7 @@ class Supervisor:
                             p.kill()
                             p.wait()
                 return [p.returncode for p in procs], failed
-            if all(c == 0 for c in codes):
+            if all(c is not None for c in codes):
                 return codes, []
             _time.sleep(self.poll_interval)
 
@@ -167,18 +191,42 @@ class Supervisor:
             procs, logs = self._launch(attempt)
             all_logs.extend(logs)
             codes, failed = self._wait_attempt(procs)
+            rescale = not failed and any(c == RESCALE_EXIT_CODE for c in codes)
             info = {
                 "attempt": attempt,
                 "exit_codes": codes,
                 "failed_processes": failed,
+                "rescale": rescale,
                 "start_ns": t0_ns,
                 "end_ns": _time.time_ns(),
             }
             self.attempts.append(info)
+            if rescale:
+                # coordinated elastic rescale: the pod quiesced to a committed
+                # epoch and published a new membership — relaunch at the new
+                # shape immediately, spending neither restart budget nor
+                # backoff (nothing failed)
+                new_processes = self._rescale_target()
+                record_event(
+                    "elastic.rescale",
+                    attempt=attempt,
+                    from_processes=self.processes,
+                    to_processes=new_processes,
+                    rescales_so_far=self.rescales,
+                )
+                if self.on_rescale is not None:
+                    self.on_rescale(self.processes, new_processes)
+                self.processes = new_processes
+                self.rescales += 1
+                attempt += 1
+                continue
             if not failed:
                 self._export_trace()
                 return SupervisorResult(
-                    restarts=self.restarts, attempts=self.attempts, log_paths=all_logs
+                    restarts=self.restarts,
+                    attempts=self.attempts,
+                    log_paths=all_logs,
+                    rescales=self.rescales,
                 )
             record_event(
                 "resilience.restart",
@@ -207,10 +255,13 @@ class Supervisor:
                     "restarts_so_far": self.restarts,
                 },
             )
-            if attempt >= self.max_restarts:
+            # rescale attempts spend no restart budget: only FAILED attempts
+            # count against it
+            failures = sum(1 for a in self.attempts if a["failed_processes"])
+            if failures - 1 >= self.max_restarts:
                 self._export_trace()
                 raise SupervisorGaveUp(
-                    f"cluster failed {attempt + 1} time(s) "
+                    f"cluster failed {failures} time(s) "
                     f"(processes {failed} exited {[codes[i] for i in failed]}); "
                     f"restart budget of {self.max_restarts} exhausted",
                     self.attempts,
@@ -224,6 +275,45 @@ class Supervisor:
                 _time.sleep(delay)
             self.restarts += 1
             attempt += 1
+
+    def _rescale_target(self) -> int:
+        """New process count from the committed membership table (the
+        coordinator published it before exiting with the rescale status).
+        ``storage`` may be a filesystem path (the PATHWAY_PERSISTENT_STORAGE
+        default), a ``persistence.Backend`` config (S3 and friends), or a raw
+        ``KVBackend``."""
+        if self.storage is None or self.storage == "":
+            raise SupervisorGaveUp(
+                "cluster exited with the elastic rescale status but the "
+                "supervisor has no persistence root to read the membership "
+                "table from; pass storage= (path, persistence.Backend, or "
+                "KVBackend) or set PATHWAY_PERSISTENT_STORAGE in the child "
+                "environment",
+                self.attempts,
+            )
+        from pathway_tpu.elastic import read_membership
+        from pathway_tpu.persistence.backends import (
+            FileBackend,
+            KVBackend,
+            backend_from_config,
+        )
+
+        if isinstance(self.storage, KVBackend):
+            backend = self.storage
+        elif isinstance(self.storage, str):
+            backend = FileBackend(self.storage)
+        else:
+            backend = backend_from_config(self.storage)
+        m = read_membership(backend)
+        if m is None:
+            raise SupervisorGaveUp(
+                f"cluster exited with the elastic rescale status but "
+                f"{self.storage!r} holds no membership table — the "
+                "coordinator died between the decision and the commit; "
+                "relaunch at the previous shape manually",
+                self.attempts,
+            )
+        return m.processes
 
     def _export_trace(self) -> None:
         """One span per attempt in an OTLP/JSON doc next to the run traces."""
