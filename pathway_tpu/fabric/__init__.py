@@ -1,0 +1,154 @@
+"""Distributed serving fabric: every cluster process is a front door.
+
+``PATHWAY_FABRIC=on`` (cluster runs only) installs a :class:`FabricPlane`
+per process after the dataflow builds:
+
+- **routing** (``routing.py``): peer processes start mirror front doors for
+  every registered route; requests landing on a non-owner door are forwarded
+  over the fabric transport to the owning process and answered byte-identical
+  to hitting the coordinator, with the r16 request trace stitching ingress
+  and owner spans under one trace id;
+- **replicas** (``replica.py``): ``pw.io.http.serve_table`` routes answer
+  read-only lookups locally from a changelog-fed replica with bounded,
+  measured staleness (``pathway_fabric_replica_lag_seconds``);
+- **limits** (``limits.py``): per-route token buckets and API-key auth run
+  at every door (the coordinator's included — those two work without the
+  fabric and without a cluster).
+
+Lifecycle mirrors the other planes (flow/elastic/audit): ``install_from_env``
+from the cluster runtime once connectors are up, ``current()`` for hot-path
+guards, ``shutdown()`` with the run.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from pathway_tpu.fabric import limits, replica, transport  # noqa: F401
+from pathway_tpu.fabric.limits import ApiKeyGuard, TokenBucket  # noqa: F401
+from pathway_tpu.fabric.replica import ReplicaStore, serve_table  # noqa: F401
+from pathway_tpu.fabric.transport import FabricUnavailable  # noqa: F401
+
+_plane = None
+
+
+def current():
+    """The installed fabric plane, or None (single-process runs, fabric off)."""
+    return _plane
+
+
+def install_from_env(runtime: Any):
+    """Install the fabric on a cluster runtime when ``PATHWAY_FABRIC=on``.
+    Called after the graph builds and connectors start (the route registry
+    and the owner's webserver are live by then); a single-process run or
+    ``off`` installs nothing and costs nothing."""
+    global _plane
+    from pathway_tpu.internals.config import get_pathway_config
+
+    cfg = get_pathway_config()
+    if cfg.fabric == "off" or cfg.processes <= 1:
+        _plane = None
+        return None
+    from pathway_tpu.fabric.routing import FabricPlane
+
+    _plane = FabricPlane(runtime, cfg)
+    _plane.install()
+    return _plane
+
+
+def shutdown() -> None:
+    global _plane
+    if _plane is not None:
+        _plane.close()
+    _plane = None
+
+
+def status(runtime: Any) -> dict | None:
+    """The ``/status`` fabric section: the plane's view on cluster runs, or
+    a replica-only view when ``serve_table`` routes live without a fabric
+    (single-process runs)."""
+    if _plane is not None and _plane.runtime is runtime:
+        return _plane.status()
+    routes = replica.live_table_routes(runtime)
+    if not routes:
+        return None
+    return {
+        "enabled": False,
+        "replica": {t.route: t.replica_snapshot() for t in routes},
+    }
+
+
+def prometheus_lines(runtime: Any) -> list[str]:
+    """``pathway_fabric_*`` exposition lines for ``/metrics``."""
+    from pathway_tpu.internals.monitoring import escape_label_value
+
+    routes = replica.live_table_routes(runtime)
+    lines: list[str] = []
+    if routes:
+        lines.append(
+            "# HELP pathway_fabric_replica_lag_seconds Measured staleness of a served table's local replica (0 on the owner)"
+        )
+        lines.append("# TYPE pathway_fabric_replica_lag_seconds gauge")
+        for t in routes:
+            lag = t.store.lag_s()
+            if lag is not None:
+                label = f'route="{escape_label_value(t.route)}"'
+                lines.append(
+                    f"pathway_fabric_replica_lag_seconds{{{label}}} {round(lag, 6)}"
+                )
+        lines.append(
+            "# HELP pathway_fabric_replica_rows Rows held by a served table's local store"
+        )
+        lines.append("# TYPE pathway_fabric_replica_rows gauge")
+        for t in routes:
+            label = f'route="{escape_label_value(t.route)}"'
+            lines.append(f"pathway_fabric_replica_rows{{{label}}} {len(t.store)}")
+        lines.append(
+            "# HELP pathway_fabric_replica_local_answers_total Lookups answered from the local store"
+        )
+        lines.append("# TYPE pathway_fabric_replica_local_answers_total counter")
+        for t in routes:
+            label = f'route="{escape_label_value(t.route)}"'
+            lines.append(
+                f"pathway_fabric_replica_local_answers_total{{{label}}} {t.local_answers}"
+            )
+        lines.append(
+            "# HELP pathway_fabric_replica_fallback_total Stale-replica lookups forwarded to the owner"
+        )
+        lines.append("# TYPE pathway_fabric_replica_fallback_total counter")
+        for t in routes:
+            label = f'route="{escape_label_value(t.route)}"'
+            lines.append(
+                f"pathway_fabric_replica_fallback_total{{{label}}} {t.fallbacks}"
+            )
+    if _plane is not None and _plane.runtime is runtime:
+        lines.append(
+            "# HELP pathway_fabric_forward_errors_total Forwards that failed at the fabric transport"
+        )
+        lines.append("# TYPE pathway_fabric_forward_errors_total counter")
+        lines.append(
+            f"pathway_fabric_forward_errors_total {_plane.forward_errors_total}"
+        )
+        lines.append(
+            "# HELP pathway_fabric_replica_casts_total Changelog broadcasts sent by the owner"
+        )
+        lines.append("# TYPE pathway_fabric_replica_casts_total counter")
+        lines.append(f"pathway_fabric_replica_casts_total {_plane.casts_total}")
+    return lines
+
+
+__all__ = [
+    "ApiKeyGuard",
+    "FabricUnavailable",
+    "ReplicaStore",
+    "TokenBucket",
+    "current",
+    "install_from_env",
+    "limits",
+    "prometheus_lines",
+    "replica",
+    "serve_table",
+    "shutdown",
+    "status",
+    "transport",
+]

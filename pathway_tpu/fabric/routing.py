@@ -1,0 +1,603 @@
+"""The fabric plane: every cluster process becomes a front door.
+
+Pre-r18, a REST route lived on the coordinator alone — the process hosting
+global worker 0 starts the webserver, and "millions of users" funnel through
+one aiohttp loop that is also running the engine. The fabric turns the route
+table (populated identically on every process at graph-definition time —
+every process executes the same program) into a pod-wide serving surface:
+
+- **Peer front doors.** Each non-owner process starts a mirror webserver per
+  registered ``PathwayWebserver`` (port offset by
+  ``PATHWAY_FABRIC_PORT_STRIDE × pid``; stride 0 on multi-host pods where
+  every host binds the same port). Engine-backed routes get a *forwarding*
+  handler; ``serve_table`` routes get a *replica* handler; ``/_schema`` and
+  404 semantics come from the same ``PathwayWebserver`` machinery, so every
+  door presents the same API surface.
+- **Forwarding.** An ingress door runs the full front-door gauntlet locally
+  — auth, token bucket, in-flight budget, payload parse, request_validator —
+  then mints the request key (pid-salted, so the request id and its derived
+  trace id are pod-unique), registers the flight with the r16 request-trace
+  plane, and calls the owning process over the fabric transport. The owner
+  injects the parsed row into the route's serving state through the SAME
+  admission/coalesce/response machinery the coordinator's own door uses, so
+  the answer is byte-identical to hitting the coordinator; the ingress door
+  relays status, body and ``Retry-After`` verbatim and stamps
+  ``X-Pathway-Fabric: forwarded:p<owner>``. The engine's own key-range
+  exchange does the scatter/gather across worker shards once the row is in.
+- **Tracing.** Ingress and owner both register the SAME request id, so both
+  sides' kept traces materialize under one derived trace id: the ingress
+  contributes ``serve/admission`` + ``fabric/forward`` spans, the owner the
+  engine decomposition — one flight, stitched across processes.
+- **Ownership.** Route inputs are SOLO sources on global worker 0, so the
+  owning process is the one hosting worker 0 (pid 0 — confirmed against the
+  r17 membership table when the elastic plane is live; replica casts carry
+  the membership version and stale-generation payloads are dropped).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import threading
+import time as _time
+from typing import Any
+
+from pathway_tpu.fabric import replica as _replica
+from pathway_tpu.fabric.transport import FabricNode, FabricUnavailable
+from pathway_tpu.internals.telemetry import record_event
+
+#: minimum seconds between owner frontier casts while tables are idle — the
+#: replica staleness clock must keep advancing without data
+_FRONTIER_INTERVAL_S = 0.25
+
+
+def _dumps(obj: Any) -> str:
+    import json
+
+    return json.dumps(obj)
+
+
+class FabricPlane:
+    """Per-run fabric state on one process (installed by the cluster runtime
+    after connectors start, torn down with the run)."""
+
+    def __init__(self, runtime: Any, cfg: Any):
+        self.runtime = runtime
+        self.pid = cfg.process_id
+        self.n_proc = cfg.processes
+        self.stride = cfg.fabric_port_stride
+        self.timeout = cfg.fabric_timeout
+        self.max_staleness_s = cfg.fabric_max_staleness_ms / 1000.0
+        self.owner_pid = 0  # the process hosting global worker 0
+        self.node = FabricNode(self.pid, self.n_proc, cfg.first_port)
+        self.doors: list[Any] = []
+        self._route_states: dict[str, Any] = {}
+        self._table_routes: dict[str, _replica.TableRoute] = {}
+        self._loop: asyncio.AbstractEventLoop | None = None
+        self._outbox: dict[str, list] = {}
+        self._outbox_lock = threading.Lock()
+        self._last_cast = 0.0
+        self._resyncing: set[str] = set()
+        self.forward_errors_total = 0
+        self.casts_total = 0
+
+    # ------------------------------------------------------------------ install
+    def install(self) -> None:
+        from pathway_tpu.internals.parse_graph import G
+        from pathway_tpu.io.http import _server as S
+
+        gen = G.generation
+        for rs in list(S._ROUTES):
+            if rs.graph_gen == gen:
+                self._route_states[rs.route] = rs
+        for tr in _replica.live_table_routes():
+            self._table_routes[tr.route] = tr
+        self.node.req_handlers["serve"] = self._handle_serve
+        self.node.req_handlers["table_lookup"] = self._handle_table_lookup
+        self.node.req_handlers["replica_snapshot"] = self._handle_replica_snapshot
+        self.node.cast_handlers["replica"] = self._handle_replica_cast
+        if self.pid == self.owner_pid:
+            loop = asyncio.new_event_loop()
+            self._loop = loop
+            threading.Thread(
+                target=loop.run_forever, name="fabric-serve", daemon=True
+            ).start()
+        else:
+            # bind this process's door states to the run so /status, limits
+            # and the heartbeat rollup see them (the driver hook only fires
+            # on the owner)
+            for rs in self._route_states.values():
+                rs.runtime = self.runtime
+                rs.configure()
+            for tr in self._table_routes.values():
+                if tr.state.route not in self._route_states:
+                    tr.state.runtime = self.runtime
+                    tr.state.configure()
+            self._build_doors()
+            for tr in self._table_routes.values():
+                self._resync(tr, wait=False)
+        record_event(
+            "fabric.installed",
+            process_id=self.pid,
+            routes=len(self._route_states),
+            tables=len(self._table_routes),
+            doors=len(self.doors),
+        )
+
+    def _build_doors(self) -> None:
+        from pathway_tpu.io.http import _server as S
+
+        live = {id(rs) for rs in self._route_states.values()}
+        live |= {id(tr.state) for tr in self._table_routes.values()}
+        live_servers = []
+        for ws in list(S._WEBSERVERS):
+            if getattr(ws, "_fabric_door", False):
+                continue
+            metas = [m for _r, _m, _h, m in ws._routes if m is not None]
+            if any(id(m.get("serving")) in live for m in metas):
+                live_servers.append(ws)
+        # a webserver's door band is [port, port + (n_proc-1)*stride]: two
+        # servers on nearby ports would silently assign the same door port to
+        # different servers — fail with the fix instead of a bind crash
+        if self.stride > 0 and len(live_servers) > 1:
+            span = (self.n_proc - 1) * self.stride
+            ports = sorted(ws.port for ws in live_servers)
+            for a, b in zip(ports, ports[1:]):
+                if b - a <= span:
+                    raise RuntimeError(
+                        f"fabric door bands overlap: webservers on ports {a} "
+                        f"and {b} each need {span + 1} consecutive ports with "
+                        f"{self.n_proc} processes at PATHWAY_FABRIC_PORT_STRIDE="
+                        f"{self.stride} — space the webserver ports at least "
+                        f"{span + 1} apart, or set the stride to 0 on "
+                        f"multi-host pods"
+                    )
+        for ws in live_servers:
+            door = S.PathwayWebserver(
+                host=ws.host, port=ws.port + self.pid * self.stride
+            )
+            door._fabric_door = True
+            for route, methods, _handler, meta in ws._routes:
+                if meta is None:
+                    continue
+                troute = meta.get("table_route")
+                if troute is not None:
+                    handler = self._make_table_handler(troute)
+                else:
+                    handler = self._make_forward_handler(meta["serving"])
+                door._add_route(route, list(methods), handler, meta)
+            door.start()
+            self.doors.append(door)
+
+    # ---------------------------------------------------------- ingress (peers)
+    def _shed_web(self, rs: Any, reason: str):
+        import aiohttp.web as web
+
+        from pathway_tpu.io.http import _server as S
+
+        rs.shed_total += 1
+        S._door_event(rs, reason)
+        status = 503 if reason == "shutting_down" else 429
+        return web.json_response(
+            {"error": "overloaded", "reason": reason},
+            status=status,
+            headers={"Retry-After": "1"},
+        )
+
+    def _make_forward_handler(self, rs: Any):
+        import aiohttp.web as web
+
+        from pathway_tpu.io.http import _server as S
+        from pathway_tpu.observability import requests as _req_trace
+
+        async def handler(request: "web.Request") -> "web.Response":
+            rs.requests_total += 1
+            gated = S.gate_check(rs, request.headers)
+            if gated is not None:
+                status, body, hdrs = gated
+                return web.json_response(body, status=status, headers=hdrs or None)
+            shed = rs.try_admit()
+            if shed is not None:
+                return self._shed_web(rs, shed)
+            payload = await S.extract_payload(rs, request)
+            if rs.request_validator is not None:
+                try:
+                    rs.request_validator(payload)
+                except Exception as e:
+                    rs.errors_total += 1
+                    return web.json_response({"error": str(e)}, status=400)
+            values = S.build_row_values(rs, payload)
+            arrival_ns = _time.time_ns()
+            # re-check the budget under the lock AT the point it grows: any
+            # number of handlers can suspend in extract_payload between the
+            # arrival-time try_admit and here (the coordinator handler's
+            # registration-lock discipline, applied to fwd_inflight)
+            with rs.lock:
+                if rs.closed:
+                    shed_reason = "shutting_down"
+                elif len(rs.futures) + rs.fwd_inflight >= rs.max_inflight:
+                    shed_reason = "max_inflight"
+                else:
+                    shed_reason = None
+                    rs.fwd_inflight += 1
+            if shed_reason is not None:
+                return self._shed_web(rs, shed_reason)
+            key = S.mint_request_key()
+            rp = _req_trace.current()
+            request_id = rp.begin(key, rs.route, arrival_ns) if rp is not None else None
+            rs.forwarded_out_total += 1
+            t0 = _time.time_ns()
+            loop = asyncio.get_running_loop()
+            try:
+                status, body, hdrs = await loop.run_in_executor(
+                    None,
+                    lambda: self.node.call(
+                        self.owner_pid,
+                        "serve",
+                        {
+                            "route": rs.route,
+                            "key": key,
+                            "values": values,
+                            "arrival_ns": arrival_ns,
+                        },
+                        self.timeout,
+                    ),
+                )
+            except FabricUnavailable as e:
+                self.forward_errors_total += 1
+                if rp is not None:
+                    rp.complete(key, "error")
+                return web.json_response(
+                    {"error": "fabric forward failed", "reason": str(e)},
+                    status=503,
+                )
+            except asyncio.CancelledError:
+                # client disconnected mid-forward (doors run with
+                # handler_cancellation=True): the registered flight record
+                # must not leak in the live table (it would pin plane.hot
+                # forever) — the owner still answers and cleans up its side
+                if rp is not None:
+                    rp.complete(key, "cancelled")
+                raise
+            finally:
+                with rs.lock:
+                    rs.fwd_inflight -= 1
+            t1 = _time.time_ns()
+            headers = dict(hdrs or {})
+            if request_id is not None:
+                headers["X-Pathway-Request-Id"] = request_id
+            headers["X-Pathway-Fabric"] = f"forwarded:p{self.owner_pid}"
+            if rp is not None:
+                rp.note_boundary(
+                    key, "fabric/forward", t0, t1, {"owner": self.owner_pid}
+                )
+                label = (
+                    "ok"
+                    if status == 200
+                    else "timeout"
+                    if status == 504
+                    else "shed"
+                    if status in (429, 503)
+                    else "error"
+                )
+                rp.complete(key, label, t1, _time.time_ns())
+            if status == 200:
+                # the OWNER's resolution pass already counted this response
+                # (responses_total is where-the-answer-was-computed, so the
+                # pod rollup stays exact); the ingress door keeps the
+                # client-observed latency, which includes the forward hop
+                rs.latency.observe((t1 - arrival_ns) / 1e9)
+            return web.Response(
+                text=body,
+                status=status,
+                content_type="application/json",
+                headers=headers,
+            )
+
+        return handler
+
+    def _make_table_handler(self, troute: _replica.TableRoute):
+        import aiohttp.web as web
+
+        from pathway_tpu.io.http import _server as S
+
+        async def handler(request: "web.Request") -> "web.Response":
+            rs = troute.state
+            rs.requests_total += 1
+            gated = S.gate_check(rs, request.headers)
+            if gated is not None:
+                status, body, hdrs = gated
+                return web.json_response(body, status=status, headers=hdrs or None)
+            t0 = _time.time_ns()
+            key = request.rel_url.query.get(troute.key_column)
+            lag = troute.store.lag_s()
+            if lag is not None and lag <= self.max_staleness_s:
+                status, body = _replica.lookup_response(troute, key)
+                troute.local_answers += 1
+                headers = {
+                    "X-Pathway-Fabric": f"replica:p{self.pid}",
+                    "X-Pathway-Replica-Lag-Ms": str(round(lag * 1e3, 1)),
+                }
+            else:
+                # stale (or never-synced) replica: never answer past the
+                # bound — forward the lookup to the authoritative store
+                troute.fallbacks += 1
+                loop = asyncio.get_running_loop()
+                try:
+                    status, body, _hdrs = await loop.run_in_executor(
+                        None,
+                        lambda: self.node.call(
+                            self.owner_pid,
+                            "table_lookup",
+                            {"route": troute.route, "key": key},
+                            self.timeout,
+                        ),
+                    )
+                except FabricUnavailable as e:
+                    self.forward_errors_total += 1
+                    return web.json_response(
+                        {"error": "fabric forward failed", "reason": str(e)},
+                        status=503,
+                    )
+                headers = {"X-Pathway-Fabric": f"forwarded:p{self.owner_pid}"}
+                self._resync(troute, wait=False)
+            if status == 200:
+                rs.responses_total += 1
+                rs.latency.observe((_time.time_ns() - t0) / 1e9)
+            else:
+                rs.errors_total += 1
+            return web.Response(
+                text=body,
+                status=status,
+                content_type="application/json",
+                headers=headers,
+            )
+
+        return handler
+
+    # ------------------------------------------------------------ owner serving
+    def _handle_serve(self, payload: dict, reply) -> None:
+        rs = self._route_states.get(payload.get("route"))
+        loop = self._loop
+        if rs is None or loop is None or rs.node is None:
+            reply((404, _dumps({"error": "unknown route"}), {}))
+            return
+        rs.forwarded_in_total += 1
+        asyncio.run_coroutine_threadsafe(self._serve_one(rs, payload, reply), loop)
+
+    async def _serve_one(self, rs: Any, payload: dict, reply) -> None:
+        from pathway_tpu.io.http import _server as S
+        from pathway_tpu.observability import requests as _req_trace
+
+        key = int(payload["key"])
+        values = tuple(payload["values"])
+        arrival_ns = int(payload["arrival_ns"])
+
+        def shed(reason: str):
+            rs.shed_total += 1
+            S._door_event(rs, reason)
+            status = 503 if reason == "shutting_down" else 429
+            reply(
+                (
+                    status,
+                    _dumps({"error": "overloaded", "reason": reason}),
+                    {"Retry-After": "1"},
+                )
+            )
+
+        fut = asyncio.get_running_loop().create_future()
+        with rs.lock:
+            if rs.closed:
+                shed("shutting_down")
+                return
+            if len(rs.futures) + rs.fwd_inflight >= rs.max_inflight:
+                shed("max_inflight")
+                return
+            rs.futures[key] = (fut, asyncio.get_running_loop(), arrival_ns, values)
+        # the owner registers the SAME request id the ingress minted, so the
+        # two processes' kept traces stitch under one derived trace id
+        rp = _req_trace.current()
+        if rp is not None:
+            rp.begin(key, rs.route, arrival_ns)
+        if not rs.push_admitted(key, values):
+            with rs.lock:
+                rs.futures.pop(key, None)
+            if rp is not None:
+                rp.drop(key)
+            shed("no_ingest_credit")
+            return
+        rs.schedule_tick()
+        try:
+            result = await asyncio.wait_for(fut, timeout=S._REQUEST_TIMEOUT_S)
+        except asyncio.TimeoutError:
+            with rs.lock:
+                ent = rs.futures.pop(key, None)
+            rs.timeouts_total += 1
+            if rp is not None:
+                rp.complete(key, "timeout")
+            if ent is not None and rs.delete_completed and rs.node is not None:
+                rs.node._append_events([(key, values, -1)])
+                rs.schedule_tick()
+            reply((504, _dumps({"error": "timeout"}), {}))
+            return
+        if result is S._SHUTDOWN:
+            if rp is not None:
+                rp.drop(key)
+            reply((503, _dumps({"error": "engine shutting down"}), {}))
+            return
+        # the response writer's resolution pass completed the owner-side
+        # flight (engine decomposition) and counted the response; only the
+        # bytes remain — identical to web.json_response's json.dumps
+        reply((200, _dumps(S._jsonable(result)), {}))
+
+    def _handle_table_lookup(self, payload: dict, reply) -> None:
+        troute = self._table_routes.get(payload.get("route"))
+        if troute is None:
+            reply((404, _dumps({"error": "unknown route"}), {}))
+            return
+        status, body = _replica.lookup_response(troute, payload.get("key"))
+        reply((status, body, {}))
+
+    def _handle_replica_snapshot(self, payload: dict, reply) -> None:
+        troute = self._table_routes.get(payload.get("route"))
+        if troute is None:
+            reply(None)
+            return
+        store = troute.store
+        with store._lock:
+            rows = dict(store.rows)
+            seq = store.seq
+            ts = store.synced_unix or _time.time()
+        reply({"rows": rows, "seq": seq, "ts": ts})
+
+    # ------------------------------------------------------------- replica feed
+    def replica_publish(self, troute: _replica.TableRoute, deltas: list) -> None:
+        """Owner tick-end hook (from serve_table's subscribe): queue one
+        tick's changelog batch for the next cast. ``prev_seq`` records the
+        sequence a replica must already hold for the accumulated deltas to
+        suffice — several ticks may coalesce into one cast."""
+        with self._outbox_lock:
+            ent = self._outbox.get(troute.route)
+            if ent is None:
+                # the store's seq was bumped by the apply() that preceded
+                # this publish, so the required predecessor is seq - 1
+                ent = self._outbox[troute.route] = {
+                    "deltas": [],
+                    "prev_seq": troute.store.seq - 1,
+                }
+            ent["deltas"].extend(deltas)
+
+    def _membership_version(self) -> int | None:
+        from pathway_tpu import elastic as _elastic
+
+        eplane = _elastic.current()
+        if eplane is not None and eplane.membership is not None:
+            return eplane.membership.version
+        return None
+
+    def on_tick_done(self, tick: int) -> None:
+        """Owner: broadcast pending changelog batches — or, at least every
+        ``_FRONTIER_INTERVAL_S``, an empty frontier stamp so replica lag
+        keeps measuring freshness while tables are idle."""
+        if self.pid != self.owner_pid or not self._table_routes:
+            return
+        now = _time.time()
+        with self._outbox_lock:
+            outbox, self._outbox = self._outbox, {}
+        if not outbox and now - self._last_cast < _FRONTIER_INTERVAL_S:
+            return
+        self._last_cast = now
+        tables = {}
+        for route, troute in self._table_routes.items():
+            ent = outbox.get(route)
+            tables[route] = {
+                "deltas": ent["deltas"] if ent else [],
+                "prev_seq": ent["prev_seq"] if ent else None,
+                "seq": troute.store.seq,
+            }
+            troute.casts_out += 1
+        payload = {"ts": now, "mv": self._membership_version(), "tables": tables}
+        for peer in range(self.n_proc):
+            if peer != self.pid:
+                self.node.cast(peer, "replica", payload, connect_timeout=1.0)
+        self.casts_total += 1
+
+    def _handle_replica_cast(self, payload: dict) -> None:
+        from pathway_tpu import elastic as _elastic
+        from pathway_tpu.elastic.membership import check_version
+
+        eplane = _elastic.current()
+        if eplane is not None and eplane.membership is not None:
+            if not check_version(
+                eplane.membership.version,
+                payload.get("mv"),
+                f"fabric:replica:p{self.pid}",
+            ):
+                return  # a pre-reshard zombie's cast: drop it
+        ts = float(payload.get("ts") or 0.0)
+        for route, entry in (payload.get("tables") or {}).items():
+            troute = self._table_routes.get(route)
+            if troute is None:
+                continue
+            deltas = entry.get("deltas") or []
+            seq = int(entry.get("seq") or 0)
+            store = troute.store
+            if deltas:
+                prev = int(entry.get("prev_seq") or 0)
+                if prev > store.seq:
+                    # missed at least one cast (joined late / send failure):
+                    # these deltas don't connect to local state — pull a
+                    # snapshot; still apply them (last write wins converges)
+                    self._resync(troute, wait=False)
+                store.apply(deltas, seq, ts)
+            else:
+                if seq > store.seq:
+                    self._resync(troute, wait=False)
+                store.frontier(seq, ts)
+
+    def _resync(self, troute: _replica.TableRoute, wait: bool) -> None:
+        """Pull a full snapshot from the owner (thread — never on the
+        transport recv loop); convergent under concurrent delta casts."""
+        if troute.route in self._resyncing:
+            return
+        self._resyncing.add(troute.route)
+
+        def pull() -> None:
+            try:
+                snap = self.node.call(
+                    self.owner_pid,
+                    "replica_snapshot",
+                    {"route": troute.route},
+                    timeout=min(5.0, self.timeout),
+                )
+                if snap is not None:
+                    troute.store.install_snapshot(
+                        snap["rows"], snap["seq"], snap["ts"]
+                    )
+            except FabricUnavailable:
+                pass  # stays stale; lookups keep falling back to the owner
+            finally:
+                self._resyncing.discard(troute.route)
+
+        if wait:
+            pull()
+        else:
+            threading.Thread(target=pull, daemon=True).start()
+
+    # ------------------------------------------------------------------- status
+    def status(self) -> dict[str, Any]:
+        return {
+            "enabled": True,
+            "process_id": self.pid,
+            "owner_pid": self.owner_pid,
+            "transport_port": self.node.port,
+            "doors": [
+                {
+                    "host": d.host,
+                    "port": d.port,
+                    "routes": sorted(r for r, _m, _h, _meta in d._routes),
+                }
+                for d in self.doors
+            ],
+            "forward_errors_total": self.forward_errors_total,
+            "replica_casts_total": self.casts_total,
+            "replica": {
+                route: troute.replica_snapshot()
+                for route, troute in sorted(self._table_routes.items())
+            },
+        }
+
+    def close(self) -> None:
+        for door in self.doors:
+            try:
+                door.stop()
+            except Exception:
+                pass
+        self.doors = []
+        loop = self._loop
+        if loop is not None:
+            try:
+                loop.call_soon_threadsafe(loop.stop)
+            except RuntimeError:
+                pass
+            self._loop = None
+        self.node.close()

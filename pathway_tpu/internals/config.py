@@ -421,6 +421,39 @@ class PathwayConfig:
         return max(1, _env_int("PATHWAY_SERVE_COALESCE_ROWS", 64))
 
     @property
+    def serve_rate(self) -> float:
+        """Per-route token-bucket refill rate (requests/second) applied at
+        EVERY front door — the coordinator's and, with the fabric on, each
+        peer's. 0 (default) disables rate limiting. Requests past the bucket
+        shed with ``429`` + an exact ``Retry-After`` derived from the refill
+        rate, counted per route per process and merged pod-wide over the
+        heartbeat telemetry."""
+        v = _env_float("PATHWAY_SERVE_RATE", 0.0)
+        if v < 0:
+            raise ValueError(f"PATHWAY_SERVE_RATE must be >= 0, got {v}")
+        return v
+
+    @property
+    def serve_burst(self) -> int:
+        """Token-bucket capacity (burst) for ``PATHWAY_SERVE_RATE``. 0
+        (default) sizes the bucket at ``max(1, ceil(rate))`` — one second of
+        refill."""
+        n = _env_int("PATHWAY_SERVE_BURST", 0)
+        if n < 0:
+            raise ValueError(f"PATHWAY_SERVE_BURST must be >= 0, got {n}")
+        return n
+
+    @property
+    def serve_api_keys(self) -> tuple[str, ...]:
+        """Comma-separated API keys accepted at every front door (presented
+        as ``X-API-Key`` or ``Authorization: Bearer``). Empty (default)
+        disables auth. With keys set, a request without a key answers ``401``
+        and a wrong key ``403`` — both shed at the door, before admission,
+        with exact per-route counters."""
+        raw = os.environ.get("PATHWAY_SERVE_API_KEYS", "")
+        return tuple(k.strip() for k in raw.split(",") if k.strip())
+
+    @property
     def serve_tick(self) -> str:
         """REST query tick scheduling: ``arrival`` (default — query arrival
         wakes the tick loop through the coalesce window above) or ``poll``
@@ -432,6 +465,57 @@ class PathwayConfig:
                 f"PATHWAY_SERVE_TICK must be arrival/poll, got {raw!r}"
             )
         return raw
+
+    # ---- distributed serving fabric (pathway_tpu/fabric) --------------------
+    @property
+    def fabric(self) -> str:
+        """Distributed serving fabric master switch: ``off`` (default — REST
+        routes live on the coordinator only, the pre-r18 behavior byte for
+        byte) or ``on`` (every cluster process starts a front door for every
+        registered route; a request landing on a non-owner process is
+        forwarded over the fabric transport to the owning process and the
+        answer relayed back byte-identical, replica-served table routes
+        answer locally from the changelog feed, and ``/_schema`` is served
+        from every door). No-op on single-process runs."""
+        raw = os.environ.get("PATHWAY_FABRIC", "off").strip().lower()
+        if raw in ("", "0", "false", "no", "off"):
+            return "off"
+        if raw in ("1", "true", "yes", "on"):
+            return "on"
+        raise ValueError(f"PATHWAY_FABRIC must be off/on, got {raw!r}")
+
+    @property
+    def fabric_port_stride(self) -> int:
+        """Front-door port offset per process: process ``i``'s door binds the
+        route's port + ``i * stride``. The default 1 keeps single-host pods
+        (tests, laptops) collision-free; multi-host pods set 0 so every host
+        serves the SAME port behind one load balancer."""
+        n = _env_int("PATHWAY_FABRIC_PORT_STRIDE", 1)
+        if n < 0:
+            raise ValueError(f"PATHWAY_FABRIC_PORT_STRIDE must be >= 0, got {n}")
+        return n
+
+    @property
+    def fabric_max_staleness_ms(self) -> float:
+        """Replica freshness bound: a replica-served table route answers
+        locally only while its changelog lag is at most this; a staler
+        replica falls back to forwarding the lookup to the owner (counted,
+        never silently stale past the bound)."""
+        v = _env_float("PATHWAY_FABRIC_MAX_STALENESS_MS", 2000.0)
+        if v <= 0:
+            raise ValueError(
+                f"PATHWAY_FABRIC_MAX_STALENESS_MS must be > 0, got {v}"
+            )
+        return v
+
+    @property
+    def fabric_timeout(self) -> float:
+        """Seconds an ingress front door waits for a forwarded request's
+        answer from the owning process before answering 503."""
+        v = _env_float("PATHWAY_FABRIC_TIMEOUT", 30.0)
+        if v <= 0:
+            raise ValueError(f"PATHWAY_FABRIC_TIMEOUT must be > 0, got {v}")
+        return v
 
     @property
     def monitoring_server(self) -> str | None:
@@ -742,6 +826,13 @@ class PathwayConfig:
                 "serve_coalesce_ms",
                 "serve_coalesce_rows",
                 "serve_tick",
+                "serve_rate",
+                "serve_burst",
+                "serve_api_keys",
+                "fabric",
+                "fabric_port_stride",
+                "fabric_max_staleness_ms",
+                "fabric_timeout",
                 "monitoring_server",
                 "profile",
                 "index_snapshot",

@@ -182,6 +182,14 @@ def run_stats(runtime) -> dict[str, Any]:
     elastic = _elastic.status(runtime)
     if elastic is not None:
         stats["elastic"] = elastic
+    # serving fabric (PATHWAY_FABRIC): this process's doors, forward health
+    # and per-route replica rows/lag (also present — replica-only — when
+    # serve_table runs without a cluster)
+    from pathway_tpu import fabric as _fabric
+
+    fabric = _fabric.status(runtime)
+    if fabric is not None:
+        stats["fabric"] = fabric
     return stats
 
 
@@ -328,6 +336,10 @@ def prometheus_text(runtime) -> str:
     from pathway_tpu import elastic as _elastic
 
     lines.extend(_elastic.prometheus_lines(runtime))
+    # ---- serving fabric (replica lag/rows, forward health) ------------------
+    from pathway_tpu import fabric as _fabric
+
+    lines.extend(_fabric.prometheus_lines(runtime))
     # ---- per-operator row-level error counters ------------------------------
     from pathway_tpu.internals import error_log as _error_log
 

@@ -16,8 +16,15 @@ if TYPE_CHECKING:
     from pathway_tpu.internals.logical import LogicalNode
 
 
+#: monotone graph generation, bumped by every ``G.clear()`` — registries
+#: that outlive the graph (REST route states, served-table stores) stamp it
+#: at definition time so a later run can tell current entries from leftovers
+_GENERATION = itertools.count()
+
+
 class ParseGraph:
     def __init__(self) -> None:
+        self.generation = next(_GENERATION)
         self.node_seq = itertools.count()
         self.nodes: list["LogicalNode"] = []
         self.outputs: list[Any] = []  # output/subscribe logical nodes

@@ -5,6 +5,7 @@ the request/response bridge that makes streaming RAG servers possible. Implement
 in this package in ``_server.py`` on aiohttp.
 """
 
+from pathway_tpu.fabric.replica import serve_table
 from pathway_tpu.io.http._server import (
     EndpointDocumentation,
     PathwayWebserver,
@@ -99,5 +100,6 @@ __all__ = [
     "read",
     "response_writer",
     "rest_connector",
+    "serve_table",
     "write",
 ]

@@ -95,14 +95,33 @@ class _RouteServing:
     the exact counters ``/status``'s serving section reports."""
 
     def __init__(self, route: str, methods: tuple[str, ...], schema):
+        from pathway_tpu.internals.parse_graph import G
         from pathway_tpu.observability.metrics import Histogram
 
         self.route = route
         self.methods = tuple(methods)
         self.schema = schema
+        # hoisted once per route: the payload-parse helpers run per request
+        # at every door, and schema dict materialization is not free there
+        if schema is not None:
+            self.schema_columns = schema.column_names()
+            self.schema_dtypes = schema.dtypes()
+            self.schema_defaults = schema.default_values()
+        else:
+            self.schema_columns, self.schema_dtypes, self.schema_defaults = (
+                [],
+                {},
+                {},
+            )
         self.lock = threading.Lock()
         self.node: ops.StreamInputNode | None = None
         self.runtime: Any = None
+        #: graph generation this route was defined under — registries outlive
+        #: graphs, so the fabric (and cleanup) must tell current from leftover
+        self.graph_gen = G.generation
+        #: the route's request_validator, exposed so fabric front doors on
+        #: peer processes validate at ingress exactly like the owner's door
+        self.request_validator: Any = None
         #: key -> (future, owning event loop, arrival time_ns, row values)
         self.futures: dict[int, tuple] = {}
         self.closed = True  # open between driver.start() and flush_pending()
@@ -114,15 +133,32 @@ class _RouteServing:
         self.tick_mode = "arrival"
         self.arrivals_since_wake = 0
         self._wake_window_t0 = 0.0
+        # front-door protection (fabric/limits): per-route token bucket +
+        # API-key guard, built in configure() from env or per-route overrides
+        self.rate_limit_override: float | None = None
+        self.api_keys_override: tuple[str, ...] | None = None
+        self.limiter: Any = None
+        self.auth: Any = None
+        #: ingress-side forwarded requests currently awaiting the owner
+        #: (fabric front doors; bounded by the same max_inflight budget)
+        self.fwd_inflight = 0
         # counters (exact; the shed path is only acceptable because of them)
         self.requests_total = 0
         self.responses_total = 0
         self.shed_total = 0
         self.errors_total = 0  # 4xx validation/parse failures
         self.timeouts_total = 0
+        self.limited_total = 0  # 429s from the token bucket
+        self.unauthorized_total = 0  # 401s (no API key presented)
+        self.forbidden_total = 0  # 403s (wrong API key)
+        self.forwarded_out_total = 0  # ingress -> owner fabric forwards
+        self.forwarded_in_total = 0  # owner side: requests arriving via fabric
         self.batches_total = 0  # response-resolution passes (~= serving ticks)
         self.batched_rows_total = 0  # responses resolved by those passes
         self.latency = Histogram()
+        #: optional extra /status fields (serve_table attaches its replica
+        #: store's rows/lag/seq here)
+        self.extra_snapshot: Any = None
 
     # ---------------------------------------------------------------- lifecycle
     def configure(self) -> None:
@@ -130,11 +166,25 @@ class _RouteServing:
         ``start`` so env changes between runs take effect)."""
         from pathway_tpu.internals.config import get_pathway_config
 
+        from pathway_tpu.fabric.limits import ApiKeyGuard, TokenBucket
+
         cfg = get_pathway_config()
         self.max_inflight = cfg.serve_max_inflight
         self.coalesce_s = cfg.serve_coalesce_ms / 1000.0
         self.coalesce_rows = cfg.serve_coalesce_rows
         self.tick_mode = cfg.serve_tick
+        rate = (
+            self.rate_limit_override
+            if self.rate_limit_override is not None
+            else cfg.serve_rate
+        )
+        self.limiter = TokenBucket(rate, cfg.serve_burst or None) if rate > 0 else None
+        keys = (
+            self.api_keys_override
+            if self.api_keys_override is not None
+            else cfg.serve_api_keys
+        )
+        self.auth = ApiKeyGuard(keys) if keys else None
         self.closed = False
 
     def flush_pending(self) -> int:
@@ -169,7 +219,7 @@ class _RouteServing:
         with self.lock:
             if self.closed:
                 return "shutting_down"
-            if len(self.futures) >= self.max_inflight:
+            if len(self.futures) + self.fwd_inflight >= self.max_inflight:
                 return "max_inflight"
         return None
 
@@ -225,7 +275,7 @@ class _RouteServing:
             return None if v is None or v == float("inf") else v
 
         with self.lock:
-            inflight = len(self.futures)
+            inflight = len(self.futures) + self.fwd_inflight
         return {
             "route": self.route,
             "methods": list(self.methods),
@@ -236,6 +286,13 @@ class _RouteServing:
             "shed_total": self.shed_total,
             "errors_total": self.errors_total,
             "timeouts_total": self.timeouts_total,
+            "limited_total": self.limited_total,
+            "unauthorized_total": self.unauthorized_total,
+            "forbidden_total": self.forbidden_total,
+            "forwarded_out_total": self.forwarded_out_total,
+            "forwarded_in_total": self.forwarded_in_total,
+            "rate_limit": self.limiter.rate if self.limiter is not None else None,
+            "auth": self.auth is not None,
             "batches_total": self.batches_total,
             "mean_batch": round(
                 self.batched_rows_total / self.batches_total, 2
@@ -260,6 +317,11 @@ def _set_results(items: list[tuple]) -> None:
 #: their routes (the monitoring plane filters by the queried runtime)
 _ROUTES: "weakref.WeakSet[_RouteServing]" = weakref.WeakSet()
 
+#: every constructed webserver; the fabric plane walks this to build peer
+#: front doors mirroring each server's route table (weak: finished graphs
+#: release their servers)
+_WEBSERVERS: "weakref.WeakSet[PathwayWebserver]" = weakref.WeakSet()
+
 #: process-wide request-key mint shared by every route: a route-local counter
 #: would hand the Nth request of two routes the SAME engine key — and the
 #: request-trace plane keys its live table (and mints request/trace ids) by
@@ -267,21 +329,171 @@ _ROUTES: "weakref.WeakSet[_RouteServing]" = weakref.WeakSet()
 _KEY_SEQ = itertools.count(1)
 
 
+def mint_request_key() -> int:
+    """Process-unique engine key for one admitted request. The sequence is
+    salted with the process id BEFORE hashing: with the fabric on, every
+    process's front door mints keys, and two processes' Nth requests must
+    never collide (the request id — and so the derived trace id — IS the
+    key). Process 0 hashes the bare sequence, so single-door runs mint the
+    exact pre-fabric keys."""
+    from pathway_tpu.internals.config import get_pathway_config
+
+    salted = (get_pathway_config().process_id << 48) ^ next(_KEY_SEQ)
+    return int(splitmix64(np.asarray([salted], dtype=np.uint64))[0])
+
+
+def _door_event(state: "_RouteServing", reason: str) -> None:
+    """Trace/request-plane breadcrumbs for a request rejected at the door."""
+    from pathway_tpu import observability as _obs
+    from pathway_tpu.observability import requests as _req_trace
+
+    tracer = _obs.current()
+    if tracer is not None:
+        tracer.event(
+            "serve/shed", {"pathway.route": state.route, "pathway.reason": reason}
+        )
+    rp = _req_trace.current()
+    if rp is not None:
+        rp.note_shed(state.route, reason)
+
+
+def gate_check(
+    state: "_RouteServing", headers: Any
+) -> tuple[int, dict, dict[str, str]] | None:
+    """Front-door protection shared by EVERY door serving this route — the
+    coordinator's aiohttp handler and each fabric peer door: API-key auth
+    (401 no key / 403 wrong key), then the per-route token bucket (429 with
+    an exact Retry-After). Returns ``(status, body, headers)`` on rejection,
+    else None. Runs before admission and before the body is read, so a
+    hostile flood costs one header inspection per request. Counters are
+    exact per process and merged pod-wide over the heartbeat telemetry."""
+    from pathway_tpu.fabric import limits as _limits
+
+    auth = state.auth
+    if auth is not None:
+        verdict = auth.check(_limits.extract_api_key(headers))
+        if verdict == _limits.UNAUTHORIZED:
+            state.unauthorized_total += 1
+            _door_event(state, "unauthorized")
+            return 401, {"error": "missing api key"}, {}
+        if verdict == _limits.FORBIDDEN:
+            state.forbidden_total += 1
+            _door_event(state, "forbidden")
+            return 403, {"error": "invalid api key"}, {}
+    limiter = state.limiter
+    if limiter is not None:
+        wait = limiter.try_take()
+        if wait > 0.0:
+            state.limited_total += 1
+            _door_event(state, "rate_limited")
+            return (
+                429,
+                {"error": "rate limited", "reason": "rate_limit"},
+                {"Retry-After": _limits.retry_after_header(wait)},
+            )
+    return None
+
+
+async def extract_payload(state: "_RouteServing", request: Any) -> dict:
+    """Request → payload dict, identically at every door (GET params coerced
+    to schema dtypes, POST bodies parsed as JSON with a raw-text fallback) —
+    the fabric forwards parsed VALUES, so ingress parsing must match the
+    owner's byte for byte."""
+    dtypes = state.schema_dtypes
+    if request.method == "GET":
+        # keep EVERY query param (request_validator may inspect extras);
+        # coerce only the schema-typed ones
+        return {
+            k: _coerce(v, dtypes[k]) if k in dtypes else v
+            for k, v in request.rel_url.query.items()
+        }
+    try:
+        return await request.json()
+    except Exception:
+        return {"query": await request.text()}
+
+
+def build_row_values(state: "_RouteServing", payload: dict) -> tuple:
+    """Payload dict → the schema-ordered values tuple pushed into the engine
+    (defaults applied, JSON columns boxed) — shared by the aiohttp handler
+    and fabric ingress doors."""
+    columns = state.schema_columns
+    dtypes = state.schema_dtypes
+    defaults = state.schema_defaults
+    values = []
+    for c in columns:
+        v = payload.get(c, defaults.get(c))
+        d = dt.unoptionalize(dtypes[c])
+        if d == dt.JSON and v is not None and not isinstance(v, Json):
+            v = Json(v)
+        values.append(v)
+    return tuple(values)
+
+
+#: the per-route counter block piggybacked on heartbeats and rolled up
+#: pod-wide (exact sheds/auth failures are the contract of shedding at all)
+_COMPACT_FIELDS = (
+    ("requests", "requests_total"),
+    ("responses", "responses_total"),
+    ("shed", "shed_total"),
+    ("limited", "limited_total"),
+    ("unauthorized", "unauthorized_total"),
+    ("forbidden", "forbidden_total"),
+    ("errors", "errors_total"),
+    ("timeouts", "timeouts_total"),
+    ("forwarded_out", "forwarded_out_total"),
+    ("forwarded_in", "forwarded_in_total"),
+)
+
+
+def _compact_counters(rs: "_RouteServing") -> dict[str, int]:
+    return {name: getattr(rs, attr) for name, attr in _COMPACT_FIELDS}
+
+
+def serving_heartbeat_summary(runtime) -> dict[str, dict] | None:
+    """route → compact counters for this process's live doors — rides the
+    heartbeat telemetry block so the coordinator's /status can roll serving
+    up cluster-wide (fabric peers count their own ingress traffic)."""
+    routes = {
+        rs.route: _compact_counters(rs)
+        for rs in list(_ROUTES)
+        if rs.runtime is runtime
+    }
+    return routes or None
+
+
 def serving_status(runtime) -> dict[str, Any] | None:
     """The ``/status`` serving section for one runtime's live routes, or None
-    when the run serves nothing."""
-    rows = sorted(
-        (rs.snapshot() for rs in list(_ROUTES) if rs.runtime is runtime),
-        key=lambda r: r["route"],
-    )
+    when the run serves nothing. On a cluster coordinator with fabric peers
+    reporting, a ``cluster`` block adds the pod-wide per-route rollup."""
+    local = [rs for rs in list(_ROUTES) if rs.runtime is runtime]
+    rows = sorted((rs.snapshot() for rs in local), key=lambda r: r["route"])
     if not rows:
         return None
-    return {
+    out = {
         "routes": rows,
         "requests_total": sum(r["requests_total"] for r in rows),
         "responses_total": sum(r["responses_total"] for r in rows),
         "shed_total": sum(r["shed_total"] for r in rows),
     }
+    monitor = getattr(runtime, "hb_monitor", None)
+    peers = monitor.peer_serving() if hasattr(monitor, "peer_serving") else {}
+    if peers:
+        merged: dict[str, dict[str, int]] = {
+            rs.route: _compact_counters(rs) for rs in local
+        }
+        for summary in peers.values():
+            for route, counters in (summary or {}).items():
+                agg = merged.setdefault(
+                    route, {name: 0 for name, _ in _COMPACT_FIELDS}
+                )
+                for name, _attr in _COMPACT_FIELDS:
+                    agg[name] = agg.get(name, 0) + int(counters.get(name, 0))
+        out["cluster"] = {
+            "n_reporting": 1 + len(peers),
+            "routes": {r: merged[r] for r in sorted(merged)},
+        }
+    return out
 
 
 def serving_prometheus_lines(runtime) -> list[str]:
@@ -299,6 +511,10 @@ def serving_prometheus_lines(runtime) -> list[str]:
         ("pathway_serve_responses_total", "Responses served by a REST route", "responses_total", "counter"),
         ("pathway_serve_shed_total", "Requests shed (429) by a REST route's admission", "shed_total", "counter"),
         ("pathway_serve_errors_total", "Requests rejected (4xx) by a REST route", "errors_total", "counter"),
+        ("pathway_serve_limited_total", "Requests shed (429) by a REST route's token bucket", "limited_total", "counter"),
+        ("pathway_serve_unauthorized_total", "Requests rejected 401 (no API key) by a REST route", "unauthorized_total", "counter"),
+        ("pathway_serve_forbidden_total", "Requests rejected 403 (wrong API key) by a REST route", "forbidden_total", "counter"),
+        ("pathway_serve_forwarded_total", "Requests this door forwarded to the owning process over the fabric", "forwarded_out_total", "counter"),
         ("pathway_serve_inflight", "Requests admitted but not yet answered", None, "gauge"),
     )
     for name, help_text, attr, mtype in counters:
@@ -307,7 +523,9 @@ def serving_prometheus_lines(runtime) -> list[str]:
         for rs in routes:
             label = f'route="{escape_label_value(rs.route)}"'
             value = (
-                len(rs.futures) if attr is None else getattr(rs, attr)
+                len(rs.futures) + rs.fwd_inflight
+                if attr is None
+                else getattr(rs, attr)
             )
             lines.append(f"{name}{{{label}}} {value}")
     lines.append("# HELP pathway_serve_latency_seconds Arrival-to-response latency per REST route")
@@ -442,6 +660,7 @@ class PathwayWebserver:
         self.host = host
         self.port = port
         self.with_cors = with_cors
+        _WEBSERVERS.add(self)
         #: (route, methods, handler, meta) — meta carries schema/documentation
         #: for OpenAPI generation and the serving state for lifecycle flushes
         self._routes: list[tuple[str, list[str], Any, dict | None]] = []
@@ -607,6 +826,8 @@ def rest_connector(
     delete_completed_queries: bool | None = None,
     request_validator: Any = None,
     documentation: Any = None,
+    rate_limit: float | None = None,
+    api_keys: Any = None,
 ) -> tuple[Table, Any]:
     """Returns ``(queries_table, response_writer)``.
 
@@ -614,20 +835,28 @@ def rest_connector(
     is served, its row is retracted from the queries table (so downstream
     state doesn't grow with request history) unless ``keep_queries=True``;
     an explicit ``delete_completed_queries`` wins over ``keep_queries``.
+
+    ``rate_limit`` / ``api_keys`` override the ``PATHWAY_SERVE_RATE`` /
+    ``PATHWAY_SERVE_API_KEYS`` front-door protection for THIS route
+    (``rate_limit=0`` disables the bucket, ``api_keys=()`` disables auth);
+    both apply at every door serving the route, fabric peers included.
     """
     ws = webserver or PathwayWebserver(host=host, port=port)
     if schema is None:
         schema = schema_mod.schema_from_types(query=str)
     columns = schema.column_names()
     np_dtypes = schema.np_dtypes()
-    dtypes = schema.dtypes()
-    defaults = schema.default_values()
     state = _RouteServing(route, methods, schema)
     state.delete_completed = (
         delete_completed_queries
         if delete_completed_queries is not None
         else not keep_queries
     )
+    state.request_validator = request_validator
+    if rate_limit is not None:
+        state.rate_limit_override = float(rate_limit)
+    if api_keys is not None:
+        state.api_keys_override = tuple(api_keys)
     _ROUTES.add(state)
 
     import aiohttp.web as web
@@ -654,35 +883,21 @@ def rest_connector(
 
     async def handler(request: "web.Request") -> "web.Response":
         state.requests_total += 1
+        gated = gate_check(state, request.headers)
+        if gated is not None:
+            status, body, hdrs = gated
+            return web.json_response(body, status=status, headers=hdrs or None)
         shed = state.try_admit()
         if shed is not None:
             return _shed_response(shed)
-        if request.method == "GET":
-            # keep EVERY query param (request_validator may inspect extras);
-            # coerce only the schema-typed ones
-            payload = {
-                k: _coerce(v, dtypes[k]) if k in dtypes else v
-                for k, v in request.rel_url.query.items()
-            }
-        else:
-            try:
-                payload = await request.json()
-            except Exception:
-                payload = {"query": await request.text()}
+        payload = await extract_payload(state, request)
         if request_validator is not None:
             try:
                 request_validator(payload)
             except Exception as e:
                 state.errors_total += 1
                 return web.json_response({"error": str(e)}, status=400)
-        values = []
-        for c in columns:
-            v = payload.get(c, defaults.get(c))
-            d = dt.unoptionalize(dtypes[c])
-            if d == dt.JSON and v is not None and not isinstance(v, Json):
-                v = Json(v)
-            values.append(v)
-        values = tuple(values)
+        values = build_row_values(state, payload)
         arrival_ns = _time_mod.time_ns()
         fut: asyncio.Future = asyncio.get_running_loop().create_future()
         loop = fut.get_loop()
@@ -695,7 +910,7 @@ def rest_connector(
                 # handlers can suspend there — the budget must bind where the
                 # futures dict actually grows
                 return _shed_response("max_inflight")
-            key = int(splitmix64(np.asarray([next(_KEY_SEQ)], dtype=np.uint64))[0])
+            key = mint_request_key()
             state.futures[key] = (fut, loop, arrival_ns, values)
         # request-scoped tracing: the admitted query row's engine key IS the
         # request id (it rides the dataflow and the cluster wire for free).

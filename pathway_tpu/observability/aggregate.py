@@ -77,6 +77,14 @@ def local_summary(runtime) -> dict[str, Any]:
     plane = _audit.current()
     if plane is not None:
         summary["audit"] = plane.heartbeat_summary()
+    # serving plane: this process's per-route front-door counters (fabric
+    # peers serve traffic of their own) so the coordinator's /status rolls
+    # requests/sheds/auth failures up pod-wide with exact totals
+    from pathway_tpu.io.http import _server as _rest_serve
+
+    serving = _rest_serve.serving_heartbeat_summary(runtime)
+    if serving is not None:
+        summary["serving"] = serving
     return summary
 
 

@@ -178,6 +178,23 @@ class RequestTracePlane:
         with self._lock:
             self.shed_total += 1
 
+    def note_boundary(
+        self,
+        key: int,
+        stage: str,
+        t0_ns: int,
+        t1_ns: int,
+        attrs: dict | None = None,
+    ) -> None:
+        """Attach one serving-side boundary event to an in-flight request
+        (fabric ingress doors record their forward round-trip here — the
+        owner's engine decomposition arrives under the same derived trace id
+        from the owner's completion)."""
+        with self._lock:
+            rec = self.live.get(int(key))
+            if rec is not None and len(rec.events) < _REQ_EVENTS_MAX:
+                rec.events.append((stage, t0_ns, t1_ns, attrs))
+
     def drop(self, key: int) -> None:
         """Forget a request without completing it (engine shutdown flush —
         the client got a 503; there is no flight to decompose)."""

@@ -483,8 +483,10 @@ class ClusterRuntime:
         self.device_plane = make_cluster_device_plane(self.n_workers, threads, pid)
         self.links = _PeerLinks(pid, processes, first_port, self._on_remote_block)
         # failure detection (resilience subsystem): a dedicated heartbeat link
-        # per peer on port first_port + processes + 1, so the cluster occupies
-        # ports [first_port, first_port + processes + 1]
+        # per peer on port first_port + processes + 1; with the serving
+        # fabric on, per-process fabric transports follow at
+        # first_port + processes + 2 + pid — the cluster occupies
+        # [first_port, first_port + 2*processes + 1]
         cfg = get_pathway_config()
         self.hb_monitor = None
         self.hb_client = None
@@ -1066,6 +1068,9 @@ class ClusterRuntime:
             raise
         finally:
             self.tracer = None
+            from pathway_tpu import fabric as _fabric
+
+            _fabric.shutdown()
             _obs.shutdown()
             _flow.shutdown()
             _elastic.shutdown()
@@ -1099,6 +1104,15 @@ class ClusterRuntime:
         # non-partitioned sources, peers own their workers' partition slices
         for driver in self.connectors:
             driver.start()
+        # serving fabric (PATHWAY_FABRIC=on): AFTER connectors — the owner's
+        # webserver and route states are live — and BEFORE the first tick, so
+        # every peer's transport is accepting before the owner's first
+        # replica cast (the startup barrier orders the two)
+        from pathway_tpu import fabric as _fabric
+
+        fplane = _fabric.install_from_env(self)
+        if fplane is not None:
+            self.on_tick_done.append(fplane.on_tick_done)
 
         period = (self.autocommit_duration_ms or 20) / 1000.0
         tick = 0

@@ -202,6 +202,19 @@ class HeartbeatMonitor:
         with self._lock:
             return {pid: st.summary for pid, st in self._peers.items()}
 
+    def peer_serving(self) -> dict[int, dict]:
+        """pid → the per-route serving-counter block piggybacked on that
+        peer's heartbeats (fabric front doors count their own ingress
+        traffic). Empty entries are dropped; the serving /status rollup and
+        the pod-wide shed/auth-failure totals read this."""
+        with self._lock:
+            out = {}
+            for pid, st in self._peers.items():
+                serving = (st.summary or {}).get("serving")
+                if serving:
+                    out[pid] = serving
+            return out
+
     def peer_flow(self) -> dict[int, dict]:
         """pid → the flow-plane credit/occupancy block piggybacked on that
         peer's heartbeats ({} until one arrives). The coordinator merges these
