@@ -15,6 +15,12 @@ Three legs:
    ``PATHWAY_ELASTIC=manual`` (reshard-by-replay: shards dropped, full log
    recomputed under the new shard map). The difference is what a rescale pays
    over a plain restart.
+2b. **migrate pause** (r19) — reopen a 2-process operator-persisted store at
+   3 processes from identical fills, A/B: ``PATHWAY_SHARDMAP_MIGRATION=on``
+   (O(moved-state): shards move, replay suffix empty) vs off (the r17
+   wipe-and-replay control). Run at 10× leg 2's event count; gated on
+   migrate replaying ZERO events while the control replays the full history,
+   and on the migrate pause beating the replay pause outright.
 3. **supervised join** — the real subprocess cycle: a 2-process cluster
    streams from a seekable broker, the driver requests ``scale --to 3``
    mid-stream, and the Supervisor relaunches at 3. Pre/post-join throughput is
@@ -152,8 +158,13 @@ def leg_reshard_pause(n: int, root: str) -> dict:
             for i in range(n):
                 broker.produce("words", f"w{i % 997}", partition=i % 2)
             _wordcount_session(broker_path, n, pstore, 2)
+            # a restored aggregate re-emits only when TOUCHED: one probe event
+            # both tickles the total (a same-shape reopen restores with an
+            # empty replay suffix and would otherwise idle forever) and times
+            # end-to-end readiness — the r19 migrate-leg discipline
+            broker.produce("words", "probe", partition=0)
             results[tag] = round(
-                _wordcount_session(broker_path, n, pstore, workers2), 3
+                _wordcount_session(broker_path, n + 1, pstore, workers2), 3
             )
         return {
             "metric": "reshard_pause",
@@ -167,6 +178,182 @@ def leg_reshard_pause(n: int, root: str) -> dict:
         }
     finally:
         os.environ.pop("PATHWAY_ELASTIC", None)
+
+
+# ------------------------------------- leg 2b: migrate pause (shard-map plane)
+
+_MIGRATE_PIPELINE = """
+import json, os, sys
+import time as _clock
+import pathway_tpu as pw
+
+phase = os.environ["PHASE"]  # fill | reopen
+n = int(os.environ["N_EVENTS"])
+expected = int(os.environ["EXPECTED_TOTAL"])
+
+
+class Sch(pw.Schema):
+    id: int = pw.column_definition(primary_key=True)
+    word: str
+    cnt: int
+
+
+def make_subject(w, nw):
+    class S(pw.io.python.ConnectorSubject):
+        # seekable no-op seek: each phase's rows are disjoint by id
+        def offset_state(self):
+            return {}
+
+        def seek(self, st):
+            pass
+
+        def run(self):
+            if phase == "fill":
+                batch = []
+                for i in range(w, n, nw):
+                    batch.append({"id": i, "word": f"w{i % 997}", "cnt": 1})
+                    if len(batch) >= 4096:
+                        self.next_batch(batch)
+                        batch = []
+                if batch:
+                    self.next_batch(batch)
+            elif w == 0:
+                # one probe row: restored aggregates re-emit only when
+                # touched, and the probe also times end-to-end readiness
+                self.next(id=n + 1, word="probe", cnt=1)
+
+    return S()
+
+
+t = pw.io.python.read_partitioned(make_subject, schema=Sch, name="src")
+counts = t.groupby(pw.this.word).reduce(pw.this.word, c=pw.reducers.count())
+# consume counts so the ~997-group keyed aggregate is LIVE state the
+# migration must actually move (an unconsumed table is pruned from the graph)
+pw.io.subscribe(counts, on_change=lambda key, row, time, is_addition: None)
+total = t.reduce(s=pw.reducers.count())
+ready = {}
+
+
+def on_total(key, row, time, is_addition):
+    if is_addition and row["s"] >= expected and "t" not in ready:
+        ready["t"] = _clock.monotonic()
+        rt = pw.internals.run.current_runtime()
+        if rt is not None:
+            rt.request_stop()
+
+
+pw.io.subscribe(total, on_change=on_total)
+t0 = _clock.monotonic()
+pw.run(
+    monitoring_level="none",
+    persistence_config=pw.persistence.Config(
+        backend=pw.persistence.Backend.filesystem(
+            os.environ["PATHWAY_PERSISTENT_STORAGE"]
+        ),
+        persistence_mode="operator_persisting",
+    ),
+)
+from pathway_tpu import elastic
+from pathway_tpu.internals import telemetry
+
+out = {
+    "ready_s": round(ready.get("t", _clock.monotonic()) - t0, 3),
+    "replayed": sum(
+        e["attrs"]["events"] for e in telemetry.events("resilience.replay")
+    ),
+    "migrate": [e["attrs"] for e in telemetry.events("elastic.migrate_restore")],
+    "reshard": [e["attrs"] for e in telemetry.events("elastic.reshard_restore")],
+    "last": elastic.last_reshard(),
+}
+print("RESULT:" + json.dumps(out), flush=True)
+"""
+
+
+def _run_migrate_session(script, n_proc, pstore, phase, n, expected, migration):
+    env = dict(
+        os.environ,
+        PATHWAY_PROCESSES=str(n_proc),
+        PATHWAY_THREADS="1",
+        PATHWAY_BARRIER_TIMEOUT="120",
+        PATHWAY_FIRST_PORT=str(_free_port_base(2 * n_proc + 2)),
+        PATHWAY_ELASTIC="manual",
+        PATHWAY_SHARDMAP="on",
+        PATHWAY_SHARDMAP_MIGRATION=migration,
+        PATHWAY_PERSISTENT_STORAGE=pstore,
+        PHASE=phase,
+        N_EVENTS=str(n),
+        EXPECTED_TOTAL=str(expected),
+        JAX_PLATFORMS="cpu",
+        PYTHONPATH=REPO,
+    )
+    procs = [
+        subprocess.Popen(
+            [sys.executable, script],
+            env=dict(env, PATHWAY_PROCESS_ID=str(pid)),
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+            text=True,
+        )
+        for pid in range(n_proc)
+    ]
+    outputs = [p.communicate(timeout=600)[0] for p in procs]
+    for p, txt in zip(procs, outputs):
+        if p.returncode != 0:
+            raise RuntimeError(f"migrate session exited {p.returncode}:\n{txt}")
+    results = []
+    for txt in outputs:
+        for line in txt.splitlines():
+            if line.startswith("RESULT:"):
+                results.append(json.loads(line[len("RESULT:") :]))
+    if len(results) != n_proc:
+        raise RuntimeError("missing RESULT lines:\n" + outputs[0])
+    return results
+
+
+def leg_migrate_pause(n: int, root: str) -> dict:
+    """The r19 headline: reopen a 2-process operator-persisted store at 3
+    processes twice from identical fills — once with O(moved-state) migration
+    (``PATHWAY_SHARDMAP_MIGRATION=on``: moved shards + empty replay suffix),
+    once on the r17 wipe-and-replay path (migration off: full history
+    replayed). The pause split is the direct measurement of "O(moved state),
+    not O(history)"; both reopens are gated on exact totals (zero loss)."""
+    script = os.path.join(root, "migrate_pipe.py")
+    with open(script, "w") as fh:
+        fh.write(_MIGRATE_PIPELINE)
+    results = {}
+    for mode, migration in (("migrate", "on"), ("replay", "off")):
+        pstore = os.path.join(root, f"mpause-{mode}")
+        shutil.rmtree(pstore, ignore_errors=True)
+        _run_migrate_session(script, 2, pstore, "fill", n, n, migration)
+        results[mode] = _run_migrate_session(
+            script, 3, pstore, "reopen", n, n + 1, migration
+        )
+    mig, rep = results["migrate"], results["replay"]
+    # per-process telemetry: ready_s is the coordinator's (the subscriber
+    # lives on worker 0), moved/replayed totals are summed pod-wide
+    mig0, rep0 = mig[0], rep[0]
+    mstats = [a for r in mig for a in (r.get("migrate") or [])]
+    return {
+        "metric": "migrate_pause",
+        "events": n,
+        "migrate_pause_s": mig0["ready_s"],
+        "replay_pause_s": rep0["ready_s"],
+        "pause_speedup": round(
+            rep0["ready_s"] / max(mig0["ready_s"], 1e-9), 2
+        ),
+        "migrate_replayed_events": sum(r["replayed"] for r in mig),
+        "replay_replayed_events": sum(r["replayed"] for r in rep),
+        "migrate_rows_moved": sum(s.get("rows_moved", 0) for s in mstats),
+        "migrate_bytes_moved": sum(s.get("bytes_moved", 0) for s in mstats),
+        "migrate_ranges_moved": max(
+            (s.get("ranges_moved", 0) for s in mstats), default=0
+        ),
+        "migrate_restore_pause_s": max(
+            (r.get("last") or {}).get("pause_s") or 0.0 for r in mig
+        ),
+        "migrate_fired": any(r.get("migrate") for r in mig),
+        "replay_fired": any(r.get("reshard") for r in rep),
+    }
 
 
 # ------------------------------------------------------ leg 3: supervised join
@@ -333,10 +520,14 @@ def main() -> int:
     with tempfile.TemporaryDirectory() as root:
         results["input_log_rebucket"] = leg_rebucket(n)
         results["reshard_pause"] = leg_reshard_pause(min(n, 20_000), root)
+        # r19 acceptance: 10x the r17 reshard-pause event count, pause split
+        # into moved-state (migrate) vs full-history (replay)
+        results["migrate_pause"] = leg_migrate_pause(10 * 20_000, root)
         results["supervised_join"] = leg_supervised_join(min(n // 10, 6_000), root)
 
     noisy = results["input_log_rebucket"]["rep_spread"] > 1.6
     failures: list[str] = []
+    gate_warnings: list[str] = []
     # hard gates: correctness is never host-dependent
     if not results["supervised_join"]["zero_loss"]:
         failures.append("supervised join lost or duplicated output rows")
@@ -346,8 +537,42 @@ def main() -> int:
         )
     if results["input_log_rebucket"]["rows_moved"] <= 0:
         failures.append("rebucket moved zero rows — the reshard did nothing")
+    # r19 gate: pause O(moved state), not O(history). Structural halves are
+    # host-independent hard gates; the wall-clock speedup downgrades on a
+    # noisy host (the r11 discipline) but the replay count never lies.
+    mp = results["migrate_pause"]
+    if not mp["migrate_fired"]:
+        failures.append("migrate reopen fell back to wipe-and-replay")
+    if not mp["replay_fired"]:
+        failures.append("replay control did not take the reshard path")
+    if mp["migrate_replayed_events"] != 0:
+        failures.append(
+            f"migrate reopen replayed {mp['migrate_replayed_events']} events — "
+            "the pause is not O(moved state)"
+        )
+    if mp["migrate_rows_moved"] <= 0:
+        failures.append("migration moved zero operator-state rows")
+    if mp["replay_replayed_events"] < mp["events"]:
+        failures.append(
+            f"replay control replayed only {mp['replay_replayed_events']} of "
+            f"{mp['events']} events — the baseline is not O(history)"
+        )
+    # the restore work itself must sit WELL below the history-replay pause
+    if mp["migrate_restore_pause_s"] * 2 >= mp["replay_pause_s"]:
+        failures.append(
+            f"migrate restore pause {mp['migrate_restore_pause_s']}s not well "
+            f"below the replay pause {mp['replay_pause_s']}s at "
+            f"{mp['events']} events"
+        )
+    if mp["pause_speedup"] <= 1.0:
+        # end-to-end wall clock: tick/barrier constants dominate on small
+        # hosts, so this one only warns (the structural gates above are the
+        # O(moved-state) claim)
+        gate_warnings.append(
+            f"end-to-end migrate pause ({mp['migrate_pause_s']}s) not below "
+            f"replay pause ({mp['replay_pause_s']}s) — constants dominate"
+        )
     # regression gate vs the last committed BENCH (noisy-host downgrade)
-    gate_warnings: list[str] = []
     prev_path = os.path.join(REPO, "BENCH_r17.json")
     if os.path.exists(prev_path):
         with open(prev_path) as fh:

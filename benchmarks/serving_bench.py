@@ -839,6 +839,275 @@ def fabric_leg() -> dict:
                 c.kill()
 
 
+# ------------------------------------------- leg 6: zero-hop vs owner-hop A/B
+
+ZEROHOP_CLIENTS = 3
+ZEROHOP_REQS_PER_CLIENT = 60
+ZEROHOP_REPS = 2
+GATE_ZEROHOP_SPEEDUP = 1.2  # all-door POST qps, shard map on vs off
+
+_ZEROHOP_CHILD = '''
+import os, sys, threading, time
+import pathway_tpu as pw
+
+port = int(sys.argv[1]); stop_file = sys.argv[2]; mon = int(sys.argv[3])
+
+ws = pw.io.http.PathwayWebserver(host="127.0.0.1", port=port)
+queries, respond = pw.io.http.rest_connector(
+    webserver=ws, route="/v1/echo", schema=pw.schema_from_types(text=str)
+)
+reply = queries.select(
+    result=pw.apply(lambda t: {"upper": t.upper(), "len": len(t)}, queries.text)
+)
+respond(reply)
+
+def watch():
+    while not os.path.exists(stop_file):
+        time.sleep(0.2)
+    rt = pw.internals.run.current_runtime()
+    if rt is not None:
+        rt.request_stop()
+
+threading.Thread(target=watch, daemon=True).start()
+pw.run(monitoring_level="none", with_http_server=bool(mon))
+'''
+
+#: closed-loop POST client (subprocess per client, same rationale as
+#: ``_FABRIC_CLIENT``): the ingest route is the one forwarding affects —
+#: replica GETs are local under either plane
+_ZEROHOP_CLIENT = '''
+import http.client, json, sys, time
+
+door = int(sys.argv[1]); reqs = int(sys.argv[2])
+seed = int(sys.argv[3]); start_at = float(sys.argv[4])
+hdrs = {"Content-Type": "application/json"}
+conn = http.client.HTTPConnection("127.0.0.1", door, timeout=60)
+for i in range(4):  # connection + pipeline warm, untimed
+    conn.request("POST", "/v1/echo", json.dumps({"text": f"warm{seed}-{i}"}), hdrs)
+    conn.getresponse().read()
+while time.time() < start_at:
+    time.sleep(0.002)
+t_start = time.time(); lats = []; errors = 0
+for i in range(reqs):
+    body = json.dumps({"text": f"q{seed}-{i} hop bench"})
+    t0 = time.perf_counter()
+    try:
+        conn.request("POST", "/v1/echo", body, hdrs)
+        r = conn.getresponse(); r.read()
+        if r.status != 200:
+            errors += 1
+            continue
+    except Exception:
+        errors += 1
+        try:
+            conn.close()
+        except Exception:
+            pass
+        conn = http.client.HTTPConnection("127.0.0.1", door, timeout=60)
+        continue
+    lats.append(time.perf_counter() - t0)
+print(json.dumps({"start": t_start, "end": time.time(), "lats": lats, "errors": errors}))
+'''
+
+
+def zerohop_leg() -> dict:
+    """Zero-hop vs owner-hop on the POST/ingest route (r19): the SAME
+    3-process, 3-door echo pod launched twice — ``PATHWAY_SHARDMAP=off``
+    (peer doors forward each request to the owner: one extra network hop)
+    vs ``on`` (each door mints a locally-owned key and answers where the
+    request landed). Byte identity across doors AND modes is the hard gate;
+    the forwarded counters from the pod's own serving rollup are the
+    structural halves — owner-hop must forward, zero-hop must not."""
+    import http.client
+    import subprocess
+    import tempfile
+
+    tmp = tempfile.mkdtemp(prefix="zerohop_bench_")
+    script = os.path.join(tmp, "echo.py")
+    with open(script, "w") as fh:
+        fh.write(_ZEROHOP_CHILD)
+    client_script = os.path.join(tmp, "client.py")
+    with open(client_script, "w") as fh:
+        fh.write(_ZEROHOP_CLIENT)
+
+    def run_pod(shardmap: str) -> dict:
+        stop_file = os.path.join(tmp, f"stop-{shardmap}")
+        # layout: [mon_port + pid] x N, then N doors, then the cluster band
+        block = _free_port_run(FABRIC_PROCS + FABRIC_PROCS + 2 * FABRIC_PROCS + 3)
+        mon_port = block
+        http_port = block + FABRIC_PROCS
+        first_port = http_port + FABRIC_PROCS
+        env = dict(os.environ)
+        env.update(
+            PATHWAY_PROCESSES=str(FABRIC_PROCS),
+            PATHWAY_THREADS="1",
+            PATHWAY_FABRIC="on",
+            PATHWAY_SHARDMAP=shardmap,
+            PATHWAY_ELASTIC="manual",
+            PATHWAY_BARRIER_TIMEOUT="60",
+            PATHWAY_FIRST_PORT=str(first_port),
+            PATHWAY_MONITORING_HTTP_PORT=str(mon_port),
+            JAX_PLATFORMS="cpu",
+            PYTHONPATH=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        )
+        children = [
+            subprocess.Popen(
+                [sys.executable, script, str(http_port), stop_file, str(mon_port)],
+                env=dict(env, PATHWAY_PROCESS_ID=str(pid)),
+                stdout=subprocess.DEVNULL,
+                stderr=subprocess.STDOUT,
+            )
+            for pid in range(FABRIC_PROCS)
+        ]
+        doors = [http_port + i for i in range(FABRIC_PROCS)]
+        try:
+            for p in doors:
+                _wait_ready(p, timeout=90)
+            time.sleep(1.0)
+
+            # byte identity: the SAME body from every door
+            bodies = []
+            for p in doors:
+                conn = http.client.HTTPConnection("127.0.0.1", p, timeout=60)
+                conn.request(
+                    "POST",
+                    "/v1/echo",
+                    json.dumps({"text": "identity probe"}),
+                    {"Content-Type": "application/json"},
+                )
+                bodies.append(conn.getresponse().read())
+                conn.close()
+
+            qps_reps = []
+            for rep in range(ZEROHOP_REPS):
+                start_at = time.time() + 1.0
+                clients = [
+                    subprocess.Popen(
+                        [
+                            sys.executable,
+                            client_script,
+                            str(doors[ci % FABRIC_PROCS]),
+                            str(ZEROHOP_REQS_PER_CLIENT),
+                            str(rep * ZEROHOP_CLIENTS + ci),
+                            str(start_at),
+                        ],
+                        stdout=subprocess.PIPE,
+                        text=True,
+                    )
+                    for ci in range(ZEROHOP_CLIENTS)
+                ]
+                lats, starts, ends, errors = [], [], [], 0
+                for c in clients:
+                    out, _ = c.communicate(timeout=300)
+                    doc = json.loads(out)
+                    lats.extend(doc["lats"])
+                    starts.append(doc["start"])
+                    ends.append(doc["end"])
+                    errors += doc["errors"]
+                assert errors == 0, f"{errors} failed POSTs (shardmap={shardmap})"
+                qps_reps.append(len(lats) / (max(ends) - min(starts)))
+
+            time.sleep(1.8)  # two heartbeats: the pod-wide rollup lands
+            status = json.loads(
+                urllib.request.urlopen(
+                    f"http://127.0.0.1:{mon_port}/status", timeout=30
+                ).read()
+            )
+            route = status["serving"]["cluster"]["routes"]["/v1/echo"]
+            return {
+                "bodies": bodies,
+                "qps_reps": qps_reps,
+                "forwarded_out": route["forwarded_out"],
+                "responses": route["responses"],
+            }
+        finally:
+            with open(stop_file, "w") as fh:
+                fh.write("stop")
+            for c in children:
+                try:
+                    c.wait(timeout=30)
+                except subprocess.TimeoutExpired:
+                    c.kill()
+
+    owner = run_pod("off")
+    zero = run_pod("on")
+    qps_owner = max(owner["qps_reps"])
+    qps_zero = max(zero["qps_reps"])
+    spread = max(
+        max(r["qps_reps"]) / max(1e-9, min(r["qps_reps"])) for r in (owner, zero)
+    )
+    return {
+        "processes": FABRIC_PROCS,
+        "clients": ZEROHOP_CLIENTS,
+        "reqs_per_client": ZEROHOP_REQS_PER_CLIENT,
+        "reps": ZEROHOP_REPS,
+        "byte_identical": len(set(owner["bodies"] + zero["bodies"])) == 1,
+        "qps_owner_hop": round(qps_owner, 1),
+        "qps_zero_hop": round(qps_zero, 1),
+        "zero_hop_speedup": round(qps_zero / max(qps_owner, 1e-9), 3),
+        "owner_hop_forwarded": owner["forwarded_out"],
+        "zero_hop_forwarded": zero["forwarded_out"],
+        "rep_spread": round(spread, 2),
+        "host_cores": os.cpu_count(),
+    }
+
+
+def zerohop_gates(z: dict, out_path: str | None) -> tuple[bool, list[str], list[str]]:
+    """(ok, failures, warnings) for the zero-hop A/B. Structural halves
+    (byte identity, forwarded counters) are host-independent hard gates; the
+    qps speedup downgrades on underpowered/noisy hosts per the fabric-leg
+    precedent — on a 2-core box both modes are core-bound, and the saved hop
+    cannot show up in wall clock."""
+    failures: list[str] = []
+    warnings: list[str] = []
+    ok = True
+    if not z["byte_identical"]:
+        ok = False
+        failures.append("zero-hop vs owner-hop answers not byte-identical")
+    if z["owner_hop_forwarded"] <= 0:
+        ok = False
+        failures.append(
+            "owner-hop control forwarded nothing — the A/B is not measuring the hop"
+        )
+    if z["zero_hop_forwarded"] != 0:
+        ok = False
+        failures.append(
+            f"zero-hop pod forwarded {z['zero_hop_forwarded']} requests on the "
+            "serve path — doors are not answering locally"
+        )
+    speedup = z["zero_hop_speedup"]
+    underpowered = (os.cpu_count() or 1) < FABRIC_PROCS + 1
+    if speedup < GATE_ZEROHOP_SPEEDUP:
+        msg = (
+            f"zero-hop speedup {speedup}x vs required {GATE_ZEROHOP_SPEEDUP}x "
+            f"(owner-hop {z['qps_owner_hop']} qps, zero-hop {z['qps_zero_hop']} qps)"
+        )
+        if underpowered:
+            warnings.append(
+                f"{msg} — downgraded: host has {os.cpu_count()} cores for "
+                f"{FABRIC_PROCS} doors + clients"
+            )
+        elif z["rep_spread"] > 1.6:
+            warnings.append(f"{msg} — downgraded: noisy host (spread {z['rep_spread']})")
+        else:
+            ok = False
+            failures.append(msg)
+    prev = _last_committed_metric(["zero_hop_speedup"], exclude=out_path)
+    if prev is not None:
+        prev_val, prev_file = prev
+        if speedup < prev_val * 0.7:
+            msg = (
+                f"zero_hop_speedup regressed: {speedup} vs {prev_val} in "
+                f"{prev_file} (allowed drop 30%)"
+            )
+            if z["rep_spread"] > 1.6 or underpowered:
+                warnings.append(f"{msg} — downgraded (noisy/underpowered host)")
+            else:
+                ok = False
+                failures.append(msg)
+    return ok, failures, warnings
+
+
 def _last_committed_metric(key_path: list, exclude: str | None = None):
     """(value, file) of ``key_path`` in the newest committed BENCH json
     carrying it (the shared regression-gate anchor)."""
@@ -975,6 +1244,7 @@ def full(n_docs: int = N_DOCS, out_path: str | None = None) -> dict:
         flood = flood_leg(docs, rng)
         rtrace = request_trace_leg(docs, rng)
         fab = fabric_leg()
+        zh = zerohop_leg()
 
         results: dict = {
             "bench": "serving",
@@ -987,11 +1257,13 @@ def full(n_docs: int = N_DOCS, out_path: str | None = None) -> dict:
                 "flood": flood,
                 "request_trace": rtrace,
                 "fabric": fab,
+                "zero_hop": zh,
             },
             # top-level copies for the regression gate + BASELINE tables
             "serving_qps": tput["serving_qps"],
             "serving_latency_speedup_x": lat["speedup_p50_x"],
             "fabric_qps_scaling": fab["fabric_qps_scaling"],
+            "zero_hop_speedup": zh["zero_hop_speedup"],
         }
         spread = tput["rep_spread"]
         noisy = spread > 1.6
@@ -1027,11 +1299,15 @@ def full(n_docs: int = N_DOCS, out_path: str | None = None) -> dict:
             gate_ok = False
             failures.append("request tracing on vs off answers not byte-identical")
         fab_ok, fab_failures, fab_warnings = fabric_gates(fab, out_path)
-        for w in fab_warnings:
+        zh_ok, zh_failures, zh_warnings = zerohop_gates(zh, out_path)
+        for w in fab_warnings + zh_warnings:
             print(f"WARNING: {w}", file=sys.stderr)
         if not fab_ok:
             gate_ok = False
             failures.extend(fab_failures)
+        if not zh_ok:
+            gate_ok = False
+            failures.extend(zh_failures)
         if not rtrace["within_budget"]:
             msg = (
                 f"request-trace default-on overhead past {TRACE_OVERHEAD_PCT}%: "
@@ -1085,17 +1361,23 @@ def full(n_docs: int = N_DOCS, out_path: str | None = None) -> dict:
 
 
 def fabric_only(out_path: str | None = None) -> dict:
-    """Just the multi-process fabric leg (r18): emits a BENCH json carrying
-    ``fabric_qps_scaling`` for the regression chain without re-running the
-    single-process serving legs (their committed numbers stand)."""
+    """Just the multi-process legs (r18/r19): emits a BENCH json carrying
+    ``fabric_qps_scaling`` and ``zero_hop_speedup`` for the regression chain
+    without re-running the single-process serving legs (their committed
+    numbers stand)."""
     fab = fabric_leg()
+    zh = zerohop_leg()
     results: dict = {
         "bench": "serving_fabric",
-        "serving": {"fabric": fab},
+        "serving": {"fabric": fab, "zero_hop": zh},
         "fabric_qps_scaling": fab["fabric_qps_scaling"],
+        "zero_hop_speedup": zh["zero_hop_speedup"],
     }
     ok, failures, warnings = fabric_gates(fab, out_path)
-    for w in warnings:
+    zh_ok, zh_failures, zh_warnings = zerohop_gates(zh, out_path)
+    ok = ok and zh_ok
+    failures = failures + zh_failures
+    for w in warnings + zh_warnings:
         print(f"WARNING: {w}", file=sys.stderr)
     results["gate_ok"] = ok
     if not ok:

@@ -50,6 +50,7 @@ from pathway_tpu.elastic.membership import (
 )
 from pathway_tpu.elastic.reshard import (
     ReshardStats,
+    adopt_orphan_suffixes,
     moved_fraction,
     orphan_workers,
     reshard_input_logs,
@@ -295,6 +296,30 @@ class ElasticPlane:
                     reason=str(decision["reason"]),
                 ),
             )
+            if get_pathway_config().shardmap == "on":
+                # the shard map versions in lockstep with the membership: the
+                # minimal-movement rebalance for the new shape commits in the
+                # same finalize step, so the relaunched pod (and any door that
+                # reads the backend) sees one consistent (membership, map) pair
+                from pathway_tpu.internals import shardmap as _shardmap
+
+                stored = _shardmap.read_shardmap(self.backend)
+                base = (
+                    stored
+                    if stored is not None
+                    else _shardmap.ShardMap.initial(
+                        self.processes * self.threads,
+                        version=self.membership.version if self.membership else 0,
+                    )
+                )
+                new_total = target * self.threads
+                if base.n_workers != new_total:
+                    _shardmap.commit_shardmap(
+                        self.backend,
+                        base.rebalance(new_total, version=int(decision["version"])),
+                    )
+                elif stored is None:
+                    _shardmap.commit_shardmap(self.backend, base)
             clear_scale_request(self.backend)
         raise ClusterRescale(
             int(decision["target"]), int(decision["version"]), str(decision["reason"])
@@ -352,6 +377,22 @@ def reshard_enabled() -> bool:
     return get_pathway_config().elastic != "off"
 
 
+def shardmap_enabled() -> bool:
+    """True when the versioned shard-map plane owns key placement
+    (``PATHWAY_SHARDMAP=on``): cluster routing, fabric doors, and rescale all
+    consult the committed ``internals/shardmap.ShardMap`` instead of the
+    derived modulo rule."""
+    return get_pathway_config().shardmap == "on"
+
+
+def migration_enabled() -> bool:
+    """True when a rescale restore may MIGRATE state — load only the re-mapped
+    key ranges' operator shards per the shard-map V→V+1 diff — instead of the
+    r17 wipe + full-log replay. Requires the shard-map plane."""
+    cfg = get_pathway_config()
+    return cfg.shardmap == "on" and cfg.shardmap_migration == "on"
+
+
 def note_reshard_restore(
     old_workers: int, new_workers: int, stats: ReshardStats | None = None
 ) -> None:
@@ -376,6 +417,38 @@ def note_reshard_restore(
         new_workers=new_workers,
         rows_moved=stats.rows_moved if stats else 0,
         bytes_moved=stats.bytes_moved if stats else 0,
+    )
+
+
+def note_migrate_restore(
+    old_workers: int,
+    new_workers: int,
+    moved_fraction_: float,
+    rows_moved: int,
+    bytes_moved: int,
+    ranges_moved: int,
+    pause_s: float,
+) -> None:
+    """Persistence reports an O(moved-state) migration restore (the shard-map
+    alternative to :func:`note_reshard_restore`'s replay path)."""
+    _LAST_RESHARD["stats"] = {
+        "mode": "migrate",
+        "old_workers": old_workers,
+        "new_workers": new_workers,
+        "moved_fraction": round(moved_fraction_, 4),
+        "rows_moved": rows_moved,
+        "bytes_moved": bytes_moved,
+        "ranges_moved": ranges_moved,
+        "pause_s": round(pause_s, 4),
+        "at_unix": _time.time(),
+    }
+    record_event(
+        "elastic.migrate_restore",
+        old_workers=old_workers,
+        new_workers=new_workers,
+        rows_moved=rows_moved,
+        bytes_moved=bytes_moved,
+        ranges_moved=ranges_moved,
     )
 
 
@@ -407,6 +480,16 @@ def prometheus_lines(runtime: Any) -> list[str]:
             "# TYPE pathway_elastic_membership_version gauge",
             f"pathway_elastic_membership_version {_PLANE.membership.version}",
         ]
+    sm = getattr(runtime, "shardmap", None)
+    if sm is not None:
+        lines += [
+            "# HELP pathway_shardmap_version Version of the active shard map",
+            "# TYPE pathway_shardmap_version gauge",
+            f"pathway_shardmap_version {sm.version}",
+            "# HELP pathway_shardmap_segments Contiguous ownership segments in the active shard map",
+            "# TYPE pathway_shardmap_segments gauge",
+            f"pathway_shardmap_segments {len(sm.starts)}",
+        ]
     rs = _LAST_RESHARD.get("stats")
     if rs is not None:
         lines += [
@@ -433,11 +516,15 @@ __all__ = [
     "install_from_env",
     "last_reshard",
     "membership_history",
+    "migration_enabled",
     "moved_fraction",
+    "note_migrate_restore",
+    "adopt_orphan_suffixes",
     "orphan_workers",
     "read_membership",
     "read_scale_request",
     "reshard_enabled",
     "reshard_input_logs",
+    "shardmap_enabled",
     "write_scale_request",
 ]

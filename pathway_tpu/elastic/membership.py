@@ -15,11 +15,16 @@ backend under ``elastic/membership`` (latest) plus an immutable
 - **monitoring** surfaces it as the ``elastic`` /status section and the
   ``pathway_cluster_processes`` gauge.
 
-Key-range ownership is derived, not stored: worker ``w`` of an ``n``-worker
-pod owns the residue class ``(key & SHARD_MASK) % n == w`` (``parallel/mesh.py
-shard_of_keys`` — the reference's low-16-bit shard rule). A membership change
-therefore IS a reshard plan: every key whose residue maps to a different owner
-under the new modulus moves, and :func:`moved_fraction` quantifies how much.
+Key-range ownership goes through the single placement authority
+``internals/keys.shard_of_keys``: by default worker ``w`` of an ``n``-worker
+pod owns the residue class ``(key & SHARD_MASK) % n == w`` (the reference's
+low-16-bit shard rule, derived, not stored); under ``PATHWAY_SHARDMAP`` the
+versioned :class:`~pathway_tpu.internals.shardmap.ShardMap` committed
+alongside each membership version stores contiguous residue ranges instead. A
+membership change therefore IS a reshard plan: under the modulo rule every key
+whose residue maps to a different owner under the new modulus moves
+(:func:`moved_fraction` quantifies how much); under the shard map only the
+minimal re-mapped ranges move (``shardmap.moved_fraction``).
 
 Stale-version hygiene: any message carrying a ``membership_version`` older
 than the current one comes from a process that predates the last reshard
@@ -64,9 +69,12 @@ class Membership:
     def n_workers(self) -> int:
         return self.processes * self.threads
 
-    def key_ranges(self) -> dict[int, str]:
+    def key_ranges(self, shard_map=None) -> dict[int, str]:
         """worker → human-readable description of its owned key range (the
-        residue class of ``shard_of_keys``); /status and docs read this."""
+        residue class of ``shard_of_keys``, or the shard map's contiguous
+        residue ranges when one is active); /status and docs read this."""
+        if shard_map is not None:
+            return shard_map.key_ranges()
         n = self.n_workers
         return {
             w: f"(key & SHARD_MASK) % {n} == {w}" for w in range(n)

@@ -164,16 +164,19 @@ def _write_log(backend: KVBackend, pid: str, events: list[tuple]) -> None:
     )
 
 
-def reshard_input_logs(backend: KVBackend, new_total: int) -> ReshardStats:
+def reshard_input_logs(
+    backend: KVBackend, new_total: int, shard_map=None
+) -> ReshardStats:
     """Re-bucket partitioned input logs into the new worker set by key range.
 
     Runs once, on the coordinator, before inputs are wrapped (peers wait on a
     barrier). Only sources with orphan logs are touched — on pure scale-out
     the old logs replay in place and routing redistributes the rows, so
     nothing needs to move. Every event of an affected source is re-owned by
-    ``shard_of_keys(key, new_total)`` and written exactly once; ordering stays
-    stable per (old worker, log position), matching the engine's
-    arrival-order tolerance (sinks re-canonicalize per tick)."""
+    ``shard_of_keys(key, new_total, shard_map)`` — the versioned shard map
+    when the plane is on, the modulo rule otherwise — and written exactly
+    once; ordering stays stable per (old worker, log position), matching the
+    engine's arrival-order tolerance (sinks re-canonicalize per tick)."""
     stats = ReshardStats(new_workers=new_total)
     families = _partitioned_inputs(backend)
     for base, members in sorted(families.items()):
@@ -196,7 +199,9 @@ def reshard_input_logs(backend: KVBackend, new_total: int) -> ReshardStats:
         # vectorized ownership for the whole family at once
         keys = np.array([ev[0] for _w, ev in merged], dtype=np.uint64)
         owners = (
-            shard_of_keys(keys, new_total) if len(keys) else np.array([], dtype=np.int32)
+            shard_of_keys(keys, new_total, shard_map=shard_map)
+            if len(keys)
+            else np.array([], dtype=np.int32)
         )
         by_owner: dict[int, list[tuple]] = {w: [] for w in range(new_total)}
         for (old_w, ev), owner in zip(merged, owners):
@@ -215,6 +220,117 @@ def reshard_input_logs(backend: KVBackend, new_total: int) -> ReshardStats:
             "elastic.reshard_input_logs",
             sources=len(stats.sources),
             rows_total=stats.rows_total,
+            rows_moved=stats.rows_moved,
+            bytes_moved=stats.bytes_moved,
+            new_workers=new_total,
+        )
+    return stats
+
+
+def _read_log_suffix(
+    backend: KVBackend, pid: str, from_offset: int
+) -> tuple[dict, list[tuple]]:
+    """Events of ``pid``'s log past absolute position ``from_offset`` —
+    tolerating a compacted prefix, unlike :func:`_read_log`: migration only
+    ever needs the suffix past the operator-snapshot offset, and trim never
+    deletes a chunk the committed manifest still covers, so
+    ``trimmed_events <= from_offset`` always holds for a sane store."""
+    meta_raw = backend.get(f"inputs/{pid}/{_META}")
+    if meta_raw is None:
+        return {}, []
+    meta = pickle.loads(meta_raw)
+    trimmed = meta.get("trimmed_events", 0)
+    if trimmed > from_offset:
+        raise RuntimeError(
+            f"elastic migrate: input log {pid!r} was compacted past the "
+            f"operator-snapshot offset ({trimmed} trimmed > {from_offset} "
+            "committed) — the store is inconsistent; clear the persistence "
+            "storage"
+        )
+    to_skip = from_offset - trimmed
+    events: list[tuple] = []
+    for i in range(meta.get("first_chunk", 0), meta.get("chunks", 0)):
+        raw = backend.get(f"inputs/{pid}/chunk_{i:08d}")
+        if raw is None:
+            continue
+        chunk = pickle.loads(raw)
+        if to_skip >= len(chunk):
+            to_skip -= len(chunk)
+            continue
+        events.extend(chunk[to_skip:])
+        to_skip = 0
+    return meta, events
+
+
+def adopt_orphan_suffixes(
+    backend: KVBackend, new_total: int, manifest_offsets: dict[str, int]
+) -> ReshardStats:
+    """Scale-in under O(moved-state) migration: an orphan worker's input log
+    holds (a) a prefix the operator snapshot already covers — its state
+    migrates as shards, nothing to replay — and (b) a suffix past the
+    snapshot offset that NO surviving worker would replay. Append each
+    orphan's suffix to the family's worker-0 log as a fresh chunk (replay
+    routes the rows to their new owners through the exchange) and delete the
+    orphan log — O(suffix) bytes, never O(history) like
+    :func:`reshard_input_logs`.
+
+    The adopted rows are foreign to worker 0's live subject, so the meta's
+    cumulative ``foreign_events`` count lets the count-based live prefix-drop
+    stay EXACT for the subject's own rows (``_PersistedInput`` subtracts it);
+    the orphan partitions' live continuation is at-least-once — their
+    reassigned subject may re-produce adopted rows — matching the
+    seek-state-dropped posture."""
+    stats = ReshardStats(new_workers=new_total)
+    for base, members in sorted(_partitioned_inputs(backend).items()):
+        orphans = sorted(w for w in members if w >= new_total)
+        if not orphans:
+            continue
+        stats.old_workers = max(stats.old_workers, max(members) + 1)
+        stats.sources.append(base)
+        adopted: list[tuple] = []
+        for w in orphans:
+            pid = members[w]
+            meta, suffix = _read_log_suffix(
+                backend, pid, int(manifest_offsets.get(pid, 0))
+            )
+            if meta.get("reader") is not None:
+                stats.seek_states_dropped += 1
+                record_event(
+                    "elastic.reshard_seek_state_dropped", source=base, worker=w
+                )
+            adopted.extend(suffix)
+            _delete_log(backend, pid)
+        base_pid = members.get(0, base)
+        meta_raw = backend.get(f"inputs/{base_pid}/{_META}")
+        meta = (
+            pickle.loads(meta_raw)
+            if meta_raw is not None
+            else {
+                "offset": 0,
+                "chunks": 0,
+                "reader": None,
+                "first_chunk": 0,
+                "trimmed_events": 0,
+                "chunk_sizes": [],
+            }
+        )
+        if adopted:
+            payload = pickle.dumps(adopted)
+            backend.put(
+                f"inputs/{base_pid}/chunk_{meta['chunks']:08d}", payload
+            )
+            meta["chunks"] = meta.get("chunks", 0) + 1
+            meta["offset"] = meta.get("offset", 0) + len(adopted)
+            meta.setdefault("chunk_sizes", []).append(len(adopted))
+            meta["foreign_events"] = meta.get("foreign_events", 0) + len(adopted)
+            stats.rows_total += len(adopted)
+            stats.rows_moved += len(adopted)
+            stats.bytes_moved += len(payload)
+        backend.put(f"inputs/{base_pid}/{_META}", pickle.dumps(meta))
+    if stats.sources:
+        record_event(
+            "elastic.migrate_input_suffixes",
+            sources=len(stats.sources),
             rows_moved=stats.rows_moved,
             bytes_moved=stats.bytes_moved,
             new_workers=new_total,

@@ -66,6 +66,42 @@ class Node:
         for a, v in state.items():
             setattr(self, a, v)
 
+    #: True when this node's state keys live on the worker the shard map says
+    #: owns them (keyed-exchange discipline) — an O(moved-state) migration may
+    #: then read only the old shards whose ranges overlap the new worker's.
+    #: Nodes whose state placement follows something OTHER than key ownership
+    #: (e.g. a partitioned source's per-partition slice) set this False and a
+    #: migration reads every old shard for them instead.
+    migrate_aligned: bool = True
+
+    def migrate_mode(self) -> str | None:
+        """How an O(moved-state) rescale may move this node's persisted shard:
+        ``"keyed"`` — state is key-addressed; merge overlapping old shards via
+        :meth:`migrate_restore`. ``"solo"`` — the node runs serially on global
+        worker 0 under every shape, so its single shard restores positionally.
+        ``None`` — neither holds; the whole restore must fall back to
+        reshard-by-replay."""
+        if type(self).migrate_restore is not Node.migrate_restore:
+            return "keyed"
+        if self.exchange_key(0) == SOLO:
+            return "solo"
+        return None
+
+    def migrate_restore(self, shards: list[dict], keep) -> dict | None:
+        """Merge old per-worker snapshot states into THIS worker's state for an
+        O(moved-state) rescale (``PATHWAY_SHARDMAP_MIGRATION``).
+
+        ``shards`` are the ``snapshot_state()`` dicts of every old worker whose
+        owned key ranges overlap this worker's new ranges; ``keep`` maps a
+        ``uint64`` key array to a boolean mask of keys this worker owns under
+        the NEW shard map. Returns a state dict for :meth:`restore_state`, or
+        ``None`` when the merged state is empty.
+
+        The default (this method not overridden) means the node does NOT
+        support keyed migration — the restore falls back to reshard-by-replay
+        for the whole pipeline (``persistence/snapshots.py``)."""
+        raise NotImplementedError
+
     def exchange_key(self, port: int):
         # stateful nodes keyed by row key need co-location by row key; stateless
         # subclasses override with None, specially-keyed ones with their key fn

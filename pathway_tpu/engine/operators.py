@@ -87,8 +87,49 @@ class StreamInputNode(Node):
     #: that node (see ``_push_gated``)
     flow_ungated = False
 
+    #: set (as an instance attribute) by serving connectors under the shard
+    #: map (``PATHWAY_SHARDMAP=on``): every fabric door pushes requests into
+    #: its OWN process's copy of this node, so exchange must route each row
+    #: by its key instead of funnelling everything to global worker 0 —
+    #: otherwise zero-hop admission would re-introduce the worker-0 hop.
+    fabric_ingest = False
+
     def exchange_key(self, port):
+        if self.fabric_ingest:
+            return lambda batch: batch.keys  # zero-hop: stay on the owner
         return SOLO  # sources/sinks live on worker 0
+
+    #: upsert state is keyed by engine key but PLACED by the connector's
+    #: partition slice, which need not follow key ownership — a migration
+    #: must scan every old worker's (small) upsert dict, not only the
+    #: shard-map overlap set
+    migrate_aligned = False
+
+    def migrate_mode(self) -> str | None:
+        # a non-partitioned source is only ever fed through global worker 0's
+        # copy, so its whole upsert dict must stay there (positional); only
+        # partition-fed or door-fed copies hold per-worker state worth a
+        # keyed merge
+        if getattr(self, "local_source", False) or self.fabric_ingest:
+            return "keyed"
+        return "solo"
+
+    def migrate_restore(self, shards: list[dict], keep) -> dict | None:
+        """Upsert-session memory (key → current row) re-owned by the NEW shard
+        map so a later upsert/delete of a migrated key still finds the row to
+        retract. Keys are engine keys, so the keep mask applies directly;
+        non-upsert sources carry an empty dict and merge trivially."""
+        merged: dict[int, tuple] = {}
+        for s in shards:
+            st = s.get("_state") or {}
+            if not st:
+                continue
+            ks = np.fromiter(st.keys(), dtype=np.uint64, count=len(st))
+            mask = keep(ks)
+            for k, keepit in zip(st.keys(), mask):
+                if keepit:
+                    merged[k] = st[k]
+        return {"_state": merged}
 
     def __init__(self, columns: list[str], np_dtypes: dict | None = None, upsert: bool = False):
         super().__init__(n_inputs=0)
@@ -1385,14 +1426,8 @@ class GroupByNode(Node):
             data[name] = np.concatenate([old_accs[r][r_idx], new_accs[r][i_idx]])
         return [DeltaBatch(keys_out, diffs_out, data, time)]
 
-    def _decolumnarize(self) -> None:
-        """A batch arrived that the columnar path can't aggregate (object
-        column): convert the array state to dict state and stay there."""
-        self.use_dict = True
-        st = self.cstate
-        self.cstate = None
-        if st is None:
-            return
+    def _cstate_entries(self, st: dict, out: dict) -> None:
+        """Expand one columnar state block into per-group dict entries."""
         gk_list = st["gk"].tolist()
         n_list = st["n"].tolist()
         gcol_lists = [column_to_list(c) for c in st["gcols"]]
@@ -1403,9 +1438,98 @@ class GroupByNode(Node):
             emitted = None
             if n_list[i] > 0 and gk != self.NONE_KEY:
                 emitted = g_tuple[: len(self.out_group_cols)] + tuple(accs)
-            self.state[gk] = {
+            out[gk] = {
                 "g": g_tuple, "acc": accs, "n": n_list[i], "emitted": emitted,
             }
+
+    def _decolumnarize(self) -> None:
+        """A batch arrived that the columnar path can't aggregate (object
+        column): convert the array state to dict state and stay there."""
+        self.use_dict = True
+        st = self.cstate
+        self.cstate = None
+        if st is None:
+            return
+        self._cstate_entries(st, self.state)
+
+    def migrate_restore(self, shards: list[dict], keep) -> dict | None:
+        """O(moved-state) rescale merge: group keys route by ``_gkeys`` so
+        every group lives on its shard-map owner — old shards are key-disjoint
+        and a plain filtered union rebuilds this worker's state. Columnar
+        blocks merge as sorted disjoint runs; if ANY old shard had fallen back
+        to the dict path the merged state must too (the dict path ignores
+        ``cstate``), so columnar blocks decolumnarize during the merge."""
+        state: dict[int, dict] = {}
+        archived: list[dict] = []
+        cparts: list[dict] = []
+        seq = 0
+        any_dict = any(s.get("use_dict") for s in shards)
+        for s in shards:
+            seq = max(seq, int(s.get("_seq", 0)))
+            for gk, gst in (s.get("state") or {}).items():
+                if bool(keep(np.asarray([gk], dtype=np.uint64))[0]):
+                    state[gk] = gst
+            for arch in s.get("_archived") or []:
+                gk_arr = np.asarray(arch["gk"], dtype=np.uint64)
+                mask = keep(gk_arr)
+                if not mask.any():
+                    continue
+                idx = np.flatnonzero(mask)
+                archived.append(
+                    {
+                        "gk": [arch["gk"][i] for i in idx],
+                        "gvals": [[col[i] for i in idx] for col in arch["gvals"]],
+                        "counts": [arch["counts"][i] for i in idx],
+                        "partials": [
+                            p[idx] if isinstance(p, np.ndarray) else [p[i] for i in idx]
+                            for p in arch["partials"]
+                        ],
+                        "extracted": [[ex[i] for i in idx] for ex in arch["extracted"]],
+                    }
+                )
+            cst = s.get("cstate")
+            if cst is not None and len(cst["gk"]):
+                mask = keep(cst["gk"])
+                if not mask.any():
+                    continue
+                part = {
+                    "gk": cst["gk"][mask],
+                    "n": cst["n"][mask],
+                    "accs": [a[mask] for a in cst["accs"]],
+                    "gcols": [c[mask] for c in cst["gcols"]],
+                }
+                if any_dict:
+                    self._cstate_entries(part, state)
+                else:
+                    cparts.append(part)
+        cstate = None
+        if cparts:
+            if len(cparts) == 1:
+                cstate = cparts[0]
+            else:
+                gk = np.concatenate([p["gk"] for p in cparts])
+                order = np.argsort(gk, kind="stable")
+                cstate = {
+                    "gk": gk[order],
+                    "n": np.concatenate([p["n"] for p in cparts])[order],
+                    "accs": [
+                        np.concatenate([p["accs"][r] for p in cparts])[order]
+                        for r in range(len(cparts[0]["accs"]))
+                    ],
+                    "gcols": [
+                        concat_cols([p["gcols"][c] for p in cparts])[order]
+                        for c in range(len(cparts[0]["gcols"]))
+                    ],
+                }
+        if not state and not archived and cstate is None:
+            return None
+        return {
+            "state": state,
+            "cstate": cstate,
+            "use_dict": any_dict,
+            "_seq": seq,
+            "_archived": archived,
+        }
 
     def process(self, inputs, time):
         tok = _phases.start()
@@ -2029,7 +2153,11 @@ class SubscribeNode(Node):
     is_sink = True
 
     def exchange_key(self, port):
-        return SOLO  # sources/sinks live on worker 0
+        # default: sources/sinks live on worker 0. With ``route_by`` set
+        # (shard-map zero-hop serving), callbacks instead fire on the worker
+        # owning each row's route key — every process observes exactly its
+        # own slice of the changelog, so N doors answer independently.
+        return self.route_by if self.route_by is not None else SOLO
 
     def __init__(
         self,
@@ -2037,6 +2165,7 @@ class SubscribeNode(Node):
         on_change: Callable | None = None,
         on_time_end: Callable | None = None,
         on_end: Callable | None = None,
+        route_by: Callable | None = None,
     ):
         super().__init__(n_inputs=1)
         self.service_class = "interactive"
@@ -2044,6 +2173,7 @@ class SubscribeNode(Node):
         self.on_change = on_change
         self.on_time_end = on_time_end
         self._on_end = on_end
+        self.route_by = route_by
         self._pending: list[DeltaBatch] = []
 
     def process(self, inputs, time):

@@ -53,6 +53,16 @@ class ReplicaStore:
         self.applied_total = 0
         #: True on the process whose subscribe feeds this store directly
         self.is_owner = False
+        # ---- shard-map mode (PATHWAY_SHARDMAP=on): ownership of a served
+        # table is PER KEY RANGE, so the changelog has one authoritative
+        # source per process and freshness must be tracked per source —
+        # a replica fresh for p1's slice may be stale for p2's
+        #: this process's source id (its pid) once the fabric binds it
+        self.self_src: int | None = None
+        #: per-source last applied changelog sequence
+        self.src_seq: dict[int, int] = {}
+        #: per-source owner wall-clock stamp of the last cast/frontier
+        self.src_synced: dict[int, float] = {}
 
     def __len__(self) -> int:
         with self._lock:
@@ -107,6 +117,61 @@ class ReplicaStore:
             return None
         return max(0.0, (now_unix or _time.time()) - self.synced_unix)
 
+    # ---------------------------------------------------- shard-map (per-src)
+    def apply_from(self, src: int, deltas: list, seq: int, ts_unix: float) -> None:
+        """:meth:`apply`, attributed to one authoritative source process —
+        the shard-map replica feed where every process casts its own slice."""
+        with self._lock:
+            for k, row, diff in deltas:
+                if diff > 0:
+                    self.rows[k] = row
+                else:
+                    self.rows.pop(k, None)
+            if seq > self.src_seq.get(src, 0):
+                self.src_seq[src] = seq
+            if ts_unix > self.src_synced.get(src, 0.0):
+                self.src_synced[src] = ts_unix
+            self.applied_total += len(deltas)
+
+    def frontier_from(self, src: int, seq: int, ts_unix: float) -> None:
+        with self._lock:
+            if seq > self.src_seq.get(src, 0):
+                self.src_seq[src] = seq
+            if ts_unix > self.src_synced.get(src, 0.0):
+                self.src_synced[src] = ts_unix
+
+    def src_gap(self, src: int, prev_seq: int) -> bool:
+        """True when ``src``'s pending deltas don't connect to local state."""
+        with self._lock:
+            return prev_seq > self.src_seq.get(src, 0)
+
+    def lag_from(self, src: int, now_unix: float | None = None) -> float | None:
+        """Staleness of ``src``'s slice: 0 when this process IS the source,
+        ``None`` when that slice never synced, else the stamp's age."""
+        if src == self.self_src:
+            return 0.0
+        ts = self.src_synced.get(src, 0.0)
+        if ts == 0.0:
+            return None
+        return max(0.0, (now_unix or _time.time()) - ts)
+
+    def install_slice(
+        self, src: int, rows: dict, seq: int, ts_unix: float, owned_fn
+    ) -> None:
+        """Install a snapshot of ONE source's slice: drop every local row the
+        source owns (``owned_fn(key) -> True``) that the snapshot no longer
+        carries, then last-write-wins the snapshot rows in — convergent under
+        concurrent delta casts from the same source."""
+        with self._lock:
+            if seq < self.src_seq.get(src, 0):
+                return  # raced an already-newer delta feed; keep it
+            for k in [k for k in self.rows if owned_fn(k) and k not in rows]:
+                del self.rows[k]
+            self.rows.update(rows)
+            self.src_seq[src] = seq
+            if ts_unix > self.src_synced.get(src, 0.0):
+                self.src_synced[src] = ts_unix
+
 
 class TableRoute:
     """One served table: route metadata + the local store + replica counters."""
@@ -123,7 +188,7 @@ class TableRoute:
     def replica_snapshot(self) -> dict[str, Any]:
         store = self.store
         lag = store.lag_s()
-        return {
+        out = {
             "route": self.route,
             "rows": len(store),
             "seq": store.seq,
@@ -133,6 +198,9 @@ class TableRoute:
             "fallbacks": self.fallbacks,
             "applied_total": store.applied_total,
         }
+        if store.src_seq:  # shard-map mode only: per-source feed positions
+            out["srcs"] = {str(s): store.src_seq[s] for s in sorted(store.src_seq)}
+        return out
 
 
 def live_table_routes(runtime=None) -> list[TableRoute]:
@@ -215,26 +283,35 @@ def serve_table(
             return web.json_response(body, status=status, headers=hdrs or None)
         t0 = _time.time_ns()
         key = request.rel_url.query.get(key_column)
-        status, body = lookup_response(troute, key)
-        troute.local_answers += 1
-        if status == 200:
-            state.responses_total += 1
-            state.latency.observe((_time.time_ns() - t0) / 1e9)
+        from pathway_tpu import fabric as _fabric
+
+        plane = _fabric.current()
+        if plane is not None and getattr(plane, "shardmap", None) is not None:
+            # shard-map mode: this door's store is authoritative only for its
+            # own key ranges — route the lookup exactly like a peer door does
+            status, body, headers = await plane.serve_table_lookup(troute, key)
         else:
-            state.errors_total += 1
-        lag = store.lag_s()
-        return web.Response(
-            text=body,
-            status=status,
-            content_type="application/json",
-            headers={
+            status, body = lookup_response(troute, key)
+            troute.local_answers += 1
+            lag = store.lag_s()
+            headers = {
                 "X-Pathway-Fabric": "owner" if store.is_owner else "local",
                 **(
                     {"X-Pathway-Replica-Lag-Ms": str(round(lag * 1e3, 1))}
                     if lag is not None
                     else {}
                 ),
-            },
+            }
+        if status == 200:
+            state.responses_total += 1
+            state.latency.observe((_time.time_ns() - t0) / 1e9)
+        else:
+            state.errors_total += 1
+        return web.Response(
+            text=body,
+            status=status,
+            content_type="application/json",
+            headers=headers,
         )
 
     ws._add_route(
@@ -274,12 +351,30 @@ def serve_table(
             plane.replica_publish(troute, batch)
 
     from pathway_tpu.flow import validate_service_class
+    from pathway_tpu.internals.config import get_pathway_config
+
+    # shard-map mode: route each changelog row to the worker owning the
+    # LOOKUP key's hash — the same hash a door computes from the query param
+    # (``stable_hash_obj(str(value))``) — so every process's subscribe slice
+    # is exactly the key ranges it serves authoritatively
+    route_by = None
+    if get_pathway_config().shardmap == "on":
+        import numpy as _np
+
+        from pathway_tpu.internals.keys import hash_column
+
+        def route_by(batch):
+            col = batch.data.get(key_column)
+            if col is None:
+                return batch.keys
+            return hash_column(_np.array([str(v) for v in col], dtype=object))
 
     sub_lnode = table._subscribe_node(
         on_change=on_change,
         on_time_end=on_time_end,
         on_end=None,
         service_class=validate_service_class("interactive"),
+        route_by=route_by,
     )
     sub_lnode._register_as_output()
 

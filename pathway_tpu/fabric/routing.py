@@ -32,6 +32,18 @@ every process executes the same program) into a pod-wide serving surface:
   owning process is the one hosting worker 0 (pid 0 — confirmed against the
   r17 membership table when the elastic plane is live; replica casts carry
   the membership version and stale-generation payloads are dropped).
+- **Zero-hop mode (r19, ``PATHWAY_SHARDMAP=on``).** With the shard map
+  live, ownership is per KEY RANGE, not per process, and the forward hop
+  disappears from the serving hot path entirely: every door mints request
+  keys it owns (``mint_local_key``), pushes them into its OWN copy of the
+  route input (keyed exchange keeps the row local), and the response
+  subscribe — also routed by key — resolves the future on the same process.
+  Doors stamp ``X-Pathway-Fabric: owner:p<pid>`` because each one IS the
+  owner of every request it admits; the only cross-process traffic left is
+  the rate-limited tick nudge to the coordinator (pid 0 owns the inter-tick
+  sleep) and the replica feed, which becomes all-to-all: each process casts
+  the changelog slice it owns, replicas track freshness per source, and a
+  stale lookup forwards to the *key's* owner — never a fixed pid 0.
 """
 
 from __future__ import annotations
@@ -40,6 +52,8 @@ import asyncio
 import threading
 import time as _time
 from typing import Any
+
+import numpy as np
 
 from pathway_tpu.fabric import replica as _replica
 from pathway_tpu.fabric.transport import FabricNode, FabricUnavailable
@@ -68,6 +82,10 @@ class FabricPlane:
         self.timeout = cfg.fabric_timeout
         self.max_staleness_s = cfg.fabric_max_staleness_ms / 1000.0
         self.owner_pid = 0  # the process hosting global worker 0
+        #: shard-map mode: the runtime's versioned ownership table, or None —
+        #: None keeps the r18 single-owner behaviour bit-for-bit
+        self.shardmap = getattr(runtime, "shardmap", None)
+        self.threads = max(1, int(getattr(runtime, "threads", 1)))
         self.node = FabricNode(self.pid, self.n_proc, cfg.first_port)
         self.doors: list[Any] = []
         self._route_states: dict[str, Any] = {}
@@ -76,9 +94,11 @@ class FabricPlane:
         self._outbox: dict[str, list] = {}
         self._outbox_lock = threading.Lock()
         self._last_cast = 0.0
-        self._resyncing: set[str] = set()
+        self._last_nudge = 0.0
+        self._resyncing: set = set()  # route (r18) or (route, src) (shard map)
         self.forward_errors_total = 0
         self.casts_total = 0
+        self.nudges_total = 0
 
     # ------------------------------------------------------------------ install
     def install(self) -> None:
@@ -95,6 +115,15 @@ class FabricPlane:
         self.node.req_handlers["table_lookup"] = self._handle_table_lookup
         self.node.req_handlers["replica_snapshot"] = self._handle_replica_snapshot
         self.node.cast_handlers["replica"] = self._handle_replica_cast
+        self.node.cast_handlers["wakeup"] = self._handle_wakeup
+        if self.shardmap is not None:
+            # zero-hop mode: every process is an authoritative changelog
+            # source for its key ranges, and peer doors must be able to wake
+            # the coordinator's tick loop when they admit a request
+            for tr in self._table_routes.values():
+                tr.store.self_src = self.pid
+            if self.pid != 0:
+                self.runtime.coord_nudge = self._nudge_coordinator
         if self.pid == self.owner_pid:
             loop = asyncio.new_event_loop()
             self._loop = loop
@@ -114,7 +143,13 @@ class FabricPlane:
                     tr.state.configure()
             self._build_doors()
             for tr in self._table_routes.values():
-                self._resync(tr, wait=False)
+                if self.shardmap is not None:
+                    # per-source slices: pull each peer's authoritative ranges
+                    for peer in range(self.n_proc):
+                        if peer != self.pid:
+                            self._resync(tr, wait=False, src=peer)
+                else:
+                    self._resync(tr, wait=False)
         record_event(
             "fabric.installed",
             process_id=self.pid,
@@ -162,6 +197,12 @@ class FabricPlane:
                 troute = meta.get("table_route")
                 if troute is not None:
                     handler = self._make_table_handler(troute)
+                elif self.shardmap is not None:
+                    # zero-hop: the route's ORIGINAL handler already does the
+                    # whole job on any process (locally-owned mint, local
+                    # push, local future resolution) — the door only stamps
+                    # the fabric header asserting no forward hop happened
+                    handler = self._make_zerohop_handler(_handler)
                 else:
                     handler = self._make_forward_handler(meta["serving"])
                 door._add_route(route, list(methods), handler, meta)
@@ -295,6 +336,106 @@ class FabricPlane:
 
         return handler
 
+    # ------------------------------------------------------ shard-map helpers
+    def owner_pid_of_key(self, key: int) -> int:
+        """Process owning engine key ``key`` per the shard map (owner worker
+        // threads-per-process); the fixed owner pid without a map."""
+        sm = self.shardmap
+        if sm is None:
+            return self.owner_pid
+        owner = int(sm.owner_of_keys(np.asarray([key], dtype=np.uint64))[0])
+        return owner // self.threads
+
+    def table_owner_pid(self, value: Any) -> int:
+        """Process owning a served table's lookup key: the query-param string
+        hashes exactly like the changelog's ``route_by`` (both reduce to
+        ``stable_hash_obj`` of the stringified value), so door-side routing
+        and engine-side placement agree byte-for-byte."""
+        if self.shardmap is None:
+            return self.owner_pid
+        from pathway_tpu.internals.keys import stable_hash_obj
+
+        return self.owner_pid_of_key(stable_hash_obj(str(value)))
+
+    def _make_zerohop_handler(self, inner):
+        import aiohttp.web as web  # noqa: F401 — door handlers are aiohttp
+
+        async def handler(request):
+            resp = await inner(request)
+            # the assertion the r19 tests (and curious operators) read: this
+            # door answered as the owner — no forward hop
+            resp.headers["X-Pathway-Fabric"] = f"owner:p{self.pid}"
+            return resp
+
+        return handler
+
+    def _handle_wakeup(self, payload: dict) -> None:
+        wakeup = getattr(self.runtime, "wakeup", None)
+        if wakeup is not None:
+            wakeup.request(float(payload.get("delay") or 0.0))
+
+    def _nudge_coordinator(self, delay: float) -> None:
+        """Peer-door tick scheduling: pid 0 owns the inter-tick sleep, so a
+        peer that admitted a request casts it a wakeup. Rate-limited to one
+        cast per millisecond — coalescing happens at the wakeup itself, the
+        fabric only needs to keep the clock honest."""
+        now = _time.monotonic()
+        if now - self._last_nudge < 0.001:
+            return
+        self._last_nudge = now
+        if self.node.cast(0, "wakeup", {"delay": delay}, connect_timeout=0.2):
+            self.nudges_total += 1
+
+    async def serve_table_lookup(
+        self, troute: _replica.TableRoute, key: str | None
+    ) -> tuple[int, str, dict]:
+        """Shard-map lookup path shared by every door (including the owner's
+        original webserver): answer authoritatively for locally-owned keys,
+        from the replica within the staleness bound for peer-owned keys, and
+        forward to the KEY'S owner — never a fixed pid — when stale."""
+        if key is None:
+            status, body = _replica.lookup_response(troute, key)
+            return status, body, {"X-Pathway-Fabric": f"owner:p{self.pid}"}
+        owner = self.table_owner_pid(key)
+        if owner == self.pid:
+            status, body = _replica.lookup_response(troute, key)
+            troute.local_answers += 1
+            return status, body, {
+                "X-Pathway-Fabric": f"owner:p{self.pid}",
+                "X-Pathway-Replica-Lag-Ms": "0.0",
+            }
+        lag = troute.store.lag_from(owner)
+        if lag is not None and lag <= self.max_staleness_s:
+            status, body = _replica.lookup_response(troute, key)
+            troute.local_answers += 1
+            return status, body, {
+                "X-Pathway-Fabric": f"replica:p{self.pid}",
+                "X-Pathway-Replica-Lag-Ms": str(round(lag * 1e3, 1)),
+            }
+        # stale (or never-synced) for THIS source's slice: never answer past
+        # the bound — one hop to the authoritative process, then catch up
+        troute.fallbacks += 1
+        loop = asyncio.get_running_loop()
+        try:
+            status, body, _hdrs = await loop.run_in_executor(
+                None,
+                lambda: self.node.call(
+                    owner,
+                    "table_lookup",
+                    {"route": troute.route, "key": key},
+                    self.timeout,
+                ),
+            )
+        except FabricUnavailable as e:
+            self.forward_errors_total += 1
+            return (
+                503,
+                _dumps({"error": "fabric forward failed", "reason": str(e)}),
+                {},
+            )
+        self._resync(troute, wait=False, src=owner)
+        return status, body, {"X-Pathway-Fabric": f"forwarded:p{owner}"}
+
     def _make_table_handler(self, troute: _replica.TableRoute):
         import aiohttp.web as web
 
@@ -309,6 +450,19 @@ class FabricPlane:
                 return web.json_response(body, status=status, headers=hdrs or None)
             t0 = _time.time_ns()
             key = request.rel_url.query.get(troute.key_column)
+            if self.shardmap is not None:
+                status, body, headers = await self.serve_table_lookup(troute, key)
+                if status == 200:
+                    rs.responses_total += 1
+                    rs.latency.observe((_time.time_ns() - t0) / 1e9)
+                else:
+                    rs.errors_total += 1
+                return web.Response(
+                    text=body,
+                    status=status,
+                    content_type="application/json",
+                    headers=headers,
+                )
             lag = troute.store.lag_s()
             if lag is not None and lag <= self.max_staleness_s:
                 status, body = _replica.lookup_response(troute, key)
@@ -447,7 +601,13 @@ class FabricPlane:
             rows = dict(store.rows)
             seq = store.seq
             ts = store.synced_unix or _time.time()
-        reply({"rows": rows, "seq": seq, "ts": ts})
+        if self.shardmap is not None:
+            # only this process's authoritative slice: the requester installs
+            # it per source, and replicated peer rows here may themselves lag
+            rows = {
+                k: v for k, v in rows.items() if self.table_owner_pid(k) == self.pid
+            }
+        reply({"rows": rows, "seq": seq, "ts": ts, "src": self.pid})
 
     # ------------------------------------------------------------- replica feed
     def replica_publish(self, troute: _replica.TableRoute, deltas: list) -> None:
@@ -477,8 +637,11 @@ class FabricPlane:
     def on_tick_done(self, tick: int) -> None:
         """Owner: broadcast pending changelog batches — or, at least every
         ``_FRONTIER_INTERVAL_S``, an empty frontier stamp so replica lag
-        keeps measuring freshness while tables are idle."""
-        if self.pid != self.owner_pid or not self._table_routes:
+        keeps measuring freshness while tables are idle. Shard-map mode:
+        EVERY process is the owner of its slice, so every process casts."""
+        if not self._table_routes:
+            return
+        if self.shardmap is None and self.pid != self.owner_pid:
             return
         now = _time.time()
         with self._outbox_lock:
@@ -495,7 +658,12 @@ class FabricPlane:
                 "seq": troute.store.seq,
             }
             troute.casts_out += 1
-        payload = {"ts": now, "mv": self._membership_version(), "tables": tables}
+        payload = {
+            "ts": now,
+            "mv": self._membership_version(),
+            "tables": tables,
+            "src": self.pid,
+        }
         for peer in range(self.n_proc):
             if peer != self.pid:
                 self.node.cast(peer, "replica", payload, connect_timeout=1.0)
@@ -514,6 +682,7 @@ class FabricPlane:
             ):
                 return  # a pre-reshard zombie's cast: drop it
         ts = float(payload.get("ts") or 0.0)
+        src = payload.get("src")
         for route, entry in (payload.get("tables") or {}).items():
             troute = self._table_routes.get(route)
             if troute is None:
@@ -521,6 +690,20 @@ class FabricPlane:
             deltas = entry.get("deltas") or []
             seq = int(entry.get("seq") or 0)
             store = troute.store
+            if self.shardmap is not None and src is not None:
+                # shard-map mode: the cast carries ONE source's slice;
+                # sequence continuity and freshness are per source
+                src = int(src)
+                if deltas:
+                    prev = int(entry.get("prev_seq") or 0)
+                    if store.src_gap(src, prev):
+                        self._resync(troute, wait=False, src=src)
+                    store.apply_from(src, deltas, seq, ts)
+                else:
+                    if seq > store.src_seq.get(src, 0):
+                        self._resync(troute, wait=False, src=src)
+                    store.frontier_from(src, seq, ts)
+                continue
             if deltas:
                 prev = int(entry.get("prev_seq") or 0)
                 if prev > store.seq:
@@ -534,29 +717,44 @@ class FabricPlane:
                     self._resync(troute, wait=False)
                 store.frontier(seq, ts)
 
-    def _resync(self, troute: _replica.TableRoute, wait: bool) -> None:
-        """Pull a full snapshot from the owner (thread — never on the
-        transport recv loop); convergent under concurrent delta casts."""
-        if troute.route in self._resyncing:
+    def _resync(
+        self, troute: _replica.TableRoute, wait: bool, src: int | None = None
+    ) -> None:
+        """Pull a snapshot from the authoritative process (thread — never on
+        the transport recv loop); convergent under concurrent delta casts.
+        Shard-map mode pulls per SOURCE slice; otherwise the pid-0 owner's
+        full store."""
+        if src is None:
+            src = self.owner_pid
+        token = (troute.route, src) if self.shardmap is not None else troute.route
+        if token in self._resyncing:
             return
-        self._resyncing.add(troute.route)
+        self._resyncing.add(token)
 
         def pull() -> None:
             try:
                 snap = self.node.call(
-                    self.owner_pid,
+                    src,
                     "replica_snapshot",
                     {"route": troute.route},
                     timeout=min(5.0, self.timeout),
                 )
-                if snap is not None:
+                if snap is not None and self.shardmap is not None:
+                    troute.store.install_slice(
+                        int(snap.get("src", src)),
+                        snap["rows"],
+                        snap["seq"],
+                        snap["ts"],
+                        lambda k: self.table_owner_pid(k) == src,
+                    )
+                elif snap is not None:
                     troute.store.install_snapshot(
                         snap["rows"], snap["seq"], snap["ts"]
                     )
             except FabricUnavailable:
                 pass  # stays stale; lookups keep falling back to the owner
             finally:
-                self._resyncing.discard(troute.route)
+                self._resyncing.discard(token)
 
         if wait:
             pull()
@@ -569,6 +767,9 @@ class FabricPlane:
             "enabled": True,
             "process_id": self.pid,
             "owner_pid": self.owner_pid,
+            "shardmap_version": (
+                None if self.shardmap is None else self.shardmap.version
+            ),
             "transport_port": self.node.port,
             "doors": [
                 {

@@ -517,6 +517,40 @@ class PathwayConfig:
             raise ValueError(f"PATHWAY_FABRIC_TIMEOUT must be > 0, got {v}")
         return v
 
+    # ---- shard-map plane (internals/shardmap) ------------------------------
+    @property
+    def shardmap(self) -> str:
+        """Versioned shard-map plane master switch: ``off`` (default — key
+        ownership stays the derived ``(key & SHARD_MASK) % n_workers`` modulo
+        rule, pre-r19 behavior byte for byte) or ``on`` (cluster placement,
+        fabric door routing, and elastic rescale all consult one committed
+        ``internals/shardmap.ShardMap`` of contiguous residue ranges: fabric
+        doors route requests directly to the key's owning process instead of
+        worker 0, and a rescale moves only the re-mapped ranges)."""
+        raw = os.environ.get("PATHWAY_SHARDMAP", "off").strip().lower()
+        if raw in ("", "0", "false", "no", "off"):
+            return "off"
+        if raw in ("1", "true", "yes", "on"):
+            return "on"
+        raise ValueError(f"PATHWAY_SHARDMAP must be off/on, got {raw!r}")
+
+    @property
+    def shardmap_migration(self) -> str:
+        """Live state migration under the shard-map plane: ``on`` (default —
+        a rescale diffs shard map V→V+1 and MOVES only the re-mapped key
+        ranges' operator shards, restoring everything else positionally, and
+        input-log trim stays enabled) or ``off`` (fall back to the r17
+        wipe-positional-shards + replay-full-input-logs path; trim stays
+        suspended). Ignored while ``PATHWAY_SHARDMAP`` is off."""
+        raw = os.environ.get("PATHWAY_SHARDMAP_MIGRATION", "on").strip().lower()
+        if raw in ("1", "true", "yes", "on", ""):
+            return "on"
+        if raw in ("0", "false", "no", "off"):
+            return "off"
+        raise ValueError(
+            f"PATHWAY_SHARDMAP_MIGRATION must be on/off, got {raw!r}"
+        )
+
     @property
     def monitoring_server(self) -> str | None:
         return os.environ.get("PATHWAY_MONITORING_SERVER")
@@ -833,6 +867,8 @@ class PathwayConfig:
                 "fabric_port_stride",
                 "fabric_max_staleness_ms",
                 "fabric_timeout",
+                "shardmap",
+                "shardmap_migration",
                 "monitoring_server",
                 "profile",
                 "index_snapshot",

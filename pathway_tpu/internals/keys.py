@@ -251,5 +251,27 @@ def shard_of(keys: np.ndarray) -> np.ndarray:
     return (keys & SHARD_MASK).astype(np.int32)
 
 
+def shard_of_keys(
+    keys: np.ndarray, n_shards: int, shard_map=None
+) -> np.ndarray:
+    """THE worker-placement formula — every layer (host exchange in
+    ``parallel/cluster``/``parallel/sharded``, the device exchange dest in
+    ``parallel/device_plane``, elastic rebucketing in ``elastic/reshard``, and
+    fabric door routing in ``fabric/routing``) routes keys through this one
+    helper so the ownership rule cannot drift between layers.
+
+    Default rule (reference ``shard.rs:15-20`` parity): low ``SHARD_BITS`` of
+    the key modulo the worker count. When a versioned shard map is passed
+    (``internals/shardmap.ShardMap``, the ``PATHWAY_SHARDMAP`` plane), ownership
+    is its segment table instead — contiguous residue ranges per worker, so a
+    rescale moves only re-mapped ranges instead of re-dealing every residue.
+    """
+    if shard_map is not None:
+        return shard_map.owner_of_keys(keys)
+    return ((keys.astype(np.uint64, copy=False) & SHARD_MASK) % np.uint64(n_shards)).astype(
+        np.int32
+    )
+
+
 def sequential_keys(start: int, n: int, salt: int = 0) -> np.ndarray:
     return splitmix64(np.arange(start, start + n, dtype=np.uint64) ^ np.uint64(salt))

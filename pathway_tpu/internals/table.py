@@ -594,11 +594,14 @@ class Table:
         on_time_end: Callable | None = None,
         on_end: Callable | None = None,
         service_class: str = "interactive",
+        route_by: Callable | None = None,
     ) -> LogicalNode:
         cols = self.column_names()
 
         def factory() -> ops.SubscribeNode:
-            n = ops.SubscribeNode(cols, on_change, on_time_end, on_end)
+            n = ops.SubscribeNode(
+                cols, on_change, on_time_end, on_end, route_by=route_by
+            )
             # flow plane SLO scope: the AIMD controller watches only
             # interactive-class sinks' latency histograms
             n.service_class = service_class

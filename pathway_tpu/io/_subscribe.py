@@ -13,6 +13,7 @@ def subscribe(
     *,
     name: str | None = None,
     service_class: str = "interactive",
+    route_by: Callable | None = None,
 ) -> None:
     """Calls ``on_change(key, row, time, is_addition)`` for every change,
     ``on_time_end(time)`` at the end of each logical time, ``on_end()`` on close.
@@ -29,5 +30,6 @@ def subscribe(
         on_time_end=on_time_end,
         on_end=on_end,
         service_class=validate_service_class(service_class),
+        route_by=route_by,
     )
     node._register_as_output()

@@ -262,7 +262,14 @@ class _RouteServing:
             if immediate:
                 self.arrivals_since_wake = 0
                 self._wake_window_t0 = now
-        wakeup.request(0.0 if immediate else self.coalesce_s)
+        delay = 0.0 if immediate else self.coalesce_s
+        nudge = getattr(self.runtime, "coord_nudge", None)
+        if nudge is not None:
+            # zero-hop peer door: the coordinator (pid 0) owns the inter-tick
+            # sleep, so this process's arrivals wake it over the fabric
+            nudge(delay)
+        else:
+            wakeup.request(delay)
 
     # ---------------------------------------------------------------- telemetry
     def snapshot(self) -> dict[str, Any]:
@@ -340,6 +347,47 @@ def mint_request_key() -> int:
 
     salted = (get_pathway_config().process_id << 48) ^ next(_KEY_SEQ)
     return int(splitmix64(np.asarray([salted], dtype=np.uint64))[0])
+
+
+def mint_local_key(state: "_RouteServing") -> int:
+    """Engine key for one admitted request, constrained (under the shard map)
+    to a key THIS process owns. Zero-hop serving hinges on this: the request
+    row, its engine work, the subscribe callback and the response future must
+    all live on the door that accepted the request, and the shard map routes
+    rows by key — so the door rejection-samples the mint until the key's
+    owner is itself. Expected tries = n_processes (geometric); the 4096-try
+    bound exists only to turn a corrupted map into a loud error. Without a
+    shard map this is exactly :func:`mint_request_key`."""
+    rt = state.runtime
+    sm = getattr(rt, "shardmap", None)
+    if sm is None:
+        return mint_request_key()
+    pid = int(getattr(rt, "pid", 0))
+    threads = max(1, int(getattr(rt, "threads", 1)))
+    for _ in range(4096):
+        key = mint_request_key()
+        owner = int(sm.owner_of_keys(np.asarray([key], dtype=np.uint64))[0])
+        if owner // threads == pid:
+            return key
+    raise RuntimeError(
+        "shardmap: could not mint a locally-owned request key "
+        f"(pid={pid}, map v{sm.version})"
+    )
+
+
+def _zerohop_owner_headers() -> dict | None:
+    """``X-Pathway-Fabric: owner:p<pid>`` when the shard-map fabric is live.
+
+    Under zero-hop routing EVERY door — the coordinator's original webserver
+    included, which never passes through a fabric door wrapper — answers as
+    the owner of the key it minted, and the header must say so truthfully on
+    all of them."""
+    from pathway_tpu import fabric as _fabric
+
+    plane = _fabric._plane
+    if plane is not None and plane.shardmap is not None:
+        return {"X-Pathway-Fabric": f"owner:p{plane.pid}"}
+    return None
 
 
 def _door_event(state: "_RouteServing", reason: str) -> None:
@@ -910,7 +958,7 @@ def rest_connector(
                 # handlers can suspend there — the budget must bind where the
                 # futures dict actually grows
                 return _shed_response("max_inflight")
-            key = mint_request_key()
+            key = mint_local_key(state)
             state.futures[key] = (fut, loop, arrival_ns, values)
         # request-scoped tracing: the admitted query row's engine key IS the
         # request id (it rides the dataflow and the cluster wire for free).
@@ -924,6 +972,9 @@ def rest_connector(
         rid_headers = (
             {"X-Pathway-Request-Id": request_id} if request_id is not None else None
         )
+        fabric_headers = _zerohop_owner_headers()
+        if fabric_headers is not None:
+            rid_headers = {**(rid_headers or {}), **fabric_headers}
         if not state.push_admitted(key, values):
             with state.lock:
                 state.futures.pop(key, None)
@@ -984,8 +1035,14 @@ def rest_connector(
     )
 
     def factory() -> Node:
+        from pathway_tpu.internals.config import get_pathway_config
+
         node = ops.StreamInputNode(columns, np_dtypes)
         node.input_name = f"rest:{route}"
+        if get_pathway_config().shardmap == "on":
+            # zero-hop serving: every fabric door pushes into its own copy of
+            # this node; keyed exchange keeps each request on its minting door
+            node.fabric_ingest = True
         state.node = node
         return node
 
@@ -1073,9 +1130,18 @@ def rest_connector(
                 # it appends directly; the rows drain on the next tick
                 state.node._append_events(retracts)
 
+        from pathway_tpu.internals.config import get_pathway_config
         from pathway_tpu.io._subscribe import subscribe
 
-        subscribe(result_table, on_change, on_time_end=on_time_end)
+        # zero-hop serving: under the shard map, each response row must fire
+        # the callback on the process holding its request future — i.e. the
+        # door that minted the (locally-owned) key — so route by row key
+        route_by = (
+            (lambda batch: batch.keys)
+            if get_pathway_config().shardmap == "on"
+            else None
+        )
+        subscribe(result_table, on_change, on_time_end=on_time_end, route_by=route_by)
 
     return queries, response_writer
 

@@ -469,6 +469,12 @@ class ClusterRuntime:
         from pathway_tpu.engine.runtime import TickWakeup
 
         self.wakeup = TickWakeup()
+        # shard-map plane (PATHWAY_SHARDMAP): the versioned ownership table
+        # every placement decision consults; None keeps the derived modulo
+        # rule. Set in run() after the elastic plane installs (the map's
+        # version rides the membership version).
+        self.shardmap = None
+        self._shardmap_prev = None
         # live tracing (observability): installed in run(), None when off
         self.tracer = None
         self._trace_active = False
@@ -600,7 +606,9 @@ class ClusterRuntime:
                         )
                         routed = True
                         continue
-                    shards = shard_of_keys(route_keys, self.n_workers)
+                    shards = shard_of_keys(
+                        route_keys, self.n_workers, shard_map=self.shardmap
+                    )
                     for w_idx in np.unique(shards):
                         piece = batch.take(np.flatnonzero(shards == w_idx))
                         self._deliver(int(w_idx), ci, port, piece)
@@ -993,7 +1001,12 @@ class ClusterRuntime:
                 if gi == 0:
                     continue
                 for node in _nodes(lw, "pollers"):
-                    if getattr(node, "local_source", False):
+                    if getattr(node, "local_source", False) or getattr(
+                        node, "fabric_ingest", False
+                    ):
+                        # fabric_ingest: zero-hop doors push REST rows into
+                        # THIS process's copy of the route input node, so
+                        # peers must poll it like a partitioned source
                         self._route(lw, node, _polled(node))
         self._round_until_quiescent(time, "sweep")
         while True:
@@ -1040,6 +1053,25 @@ class ClusterRuntime:
         # backend the membership table lives in
         _elastic.install_from_env(self)
         eplane = _elastic.current()
+        if get_pathway_config().shardmap == "on":
+            # shard-map plane: derive (and, coordinator, commit) the versioned
+            # ownership table BEFORE build/persistence — restores and door
+            # routing both consult it. Derivation is deterministic from the
+            # stored map + pod shape, so every process agrees without a
+            # barrier; without a backend the equal initial split is used.
+            from pathway_tpu.internals import shardmap as _shardmap
+
+            backend = getattr(self.persistence, "backend", None)
+            version = (
+                eplane.membership.version
+                if eplane is not None and eplane.membership is not None
+                else 0
+            )
+            self.shardmap, self._shardmap_prev = _shardmap.ensure_shardmap(
+                backend, self.n_workers, version, commit=(self.pid == 0)
+            )
+            if self.device_plane is not None:
+                self.device_plane.shard_map = self.shardmap
         if (
             eplane is not None
             and eplane.membership is not None

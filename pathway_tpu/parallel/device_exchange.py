@@ -5,8 +5,13 @@ The reference exchanges records between workers over timely's channels
 (``parallel/cluster.py``). This module is the ICI/DCN data plane the north
 star calls for: NUMERIC column blocks are re-sharded **on device** with one
 ``lax.all_to_all`` per tick — rows ride the interconnect as dense tensors,
-with the shard function identical to the host plane
-(``mesh.shard_of_keys``: low key bits mod worker count, ``shard.rs`` parity).
+with the shard function identical to the host plane — both go through the
+ONE placement authority ``internals/keys.shard_of_keys`` (low key bits mod
+worker count, ``shard.rs`` parity). The in-kernel modulo below is the
+``dest=None`` fast path only; when a versioned shard map is active
+(``PATHWAY_SHARDMAP``, ``internals/shardmap``), callers compute destinations
+host-side via ``shard_of_keys(..., shard_map=...)`` and pass explicit
+``dest`` so the kernel never re-derives ownership.
 
 Shape discipline (XLA needs static shapes): every device holds a fixed
 ``capacity``-row block with a validity mask; the kernel buckets rows by
@@ -177,7 +182,8 @@ def _kernel(n_shards: int, axis: str, with_dest: bool = False, fused: bool = Fal
 
 def exchange_by_key(mesh, axis: str, keys, diffs, cols, valid, dest=None, dig=None):
     """Re-shard padded per-device blocks so every row lands on the device
-    owning its key shard (host-plane parity: ``mesh.shard_of_keys``).
+    owning its key shard (host-plane parity: ``internals/keys.shard_of_keys``,
+    re-exported as ``mesh.shard_of_keys``).
 
     Inputs are GLOBAL arrays sharded along ``axis`` on their first dim:
     ``keys`` uint32 (2, n_dev*cap) as (hi, lo) pairs, ``diffs`` int32,

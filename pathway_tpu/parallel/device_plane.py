@@ -315,6 +315,11 @@ class ClusterDevicePlane(DeviceExchangePlane):
         self.n_global = n_workers_global
         self.threads = threads
         self.pid = pid
+        # versioned shard map (PATHWAY_SHARDMAP): set by ClusterRuntime.run();
+        # None keeps the modulo rule. Destinations are always computed
+        # host-side here and passed explicitly, so the in-kernel modulo never
+        # re-derives ownership on this path.
+        self.shard_map = None
 
     def flush(self, deliver, time: int) -> bool:
         """``deliver(global_worker, consumer, port, batch)`` — the cluster's
@@ -331,7 +336,7 @@ class ClusterDevicePlane(DeviceExchangePlane):
         for (ci, port) in sorted(staged):
             local_entries = []
             for (w_global, rk, b) in staged[(ci, port)]:
-                shards = shard_of_keys(rk, self.n_global)
+                shards = shard_of_keys(rk, self.n_global, shard_map=self.shard_map)
                 remote = (shards < lo) | (shards >= hi)
                 if remote.any():
                     for dest_w in np.unique(shards[remote]):

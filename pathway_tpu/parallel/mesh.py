@@ -13,15 +13,16 @@ import os
 
 import numpy as np
 
-from pathway_tpu.internals.keys import SHARD_MASK
+from pathway_tpu.internals.keys import SHARD_MASK  # noqa: F401  (re-export)
+from pathway_tpu.internals.keys import shard_of_keys as _shard_of_keys
 
 
-def shard_of_keys(keys: np.ndarray, n_shards: int) -> np.ndarray:
-    """Worker assignment for row keys: low shard bits modulo the worker count
-    (reference ``shard.rs:15-20``: shard = low 16 bits of the key)."""
-    return ((keys.astype(np.uint64) & SHARD_MASK) % np.uint64(n_shards)).astype(
-        np.int32
-    )
+def shard_of_keys(keys: np.ndarray, n_shards: int, shard_map=None) -> np.ndarray:
+    """Worker assignment for row keys — delegates to the single authority in
+    ``internals/keys.shard_of_keys`` (low shard bits modulo the worker count,
+    reference ``shard.rs:15-20``; or the versioned shard map's segment table
+    when one is active, see ``internals/shardmap``)."""
+    return _shard_of_keys(keys, n_shards, shard_map=shard_map)
 
 
 def distributed_initialize(

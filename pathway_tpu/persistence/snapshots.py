@@ -76,6 +76,10 @@ class _PersistedInput:
         self.trimmed_events = 0  # events contained in compacted chunks
         self.chunk_sizes: list[int] = []  # sizes of chunks [first_chunk, n_chunks)
         self.resharded = False  # log was key-range rebucketed by a rescale
+        # events appended from ORPHAN workers' logs by a scale-in migration
+        # (elastic/reshard.adopt_orphan_suffixes) — foreign to this log's live
+        # subject, so they must not count toward its prefix-drop offset
+        self.foreign_events = 0
         self._load_metadata()
         self.persisted = self.stored_offset
         # operator snapshots: state already covers this absolute log prefix
@@ -84,6 +88,13 @@ class _PersistedInput:
             if self.reader_state is not None:
                 subject.seek(self.reader_state)
             self.stored_offset = 0  # seek replaces the prefix-drop entirely
+        elif self.foreign_events and self.stored_offset and not self.resharded:
+            # adopted-suffix log: the subject re-produces only its OWN rows, so
+            # the exact drop count is the persisted total minus the adopted
+            # foreign rows — the subject's slice stays exactly-once across the
+            # rescale (the foreign rows replay from the log; their reassigned
+            # live partitions are at-least-once, see adopt_orphan_suffixes)
+            self.stored_offset = max(0, self.stored_offset - self.foreign_events)
         elif self.resharded and self.stored_offset:
             # the rebucketed log holds a KEY-RANGE slice; the subject's live
             # slice follows its own (changed) partition map, so the
@@ -120,6 +131,7 @@ class _PersistedInput:
             self.trimmed_events = meta.get("trimmed_events", 0)
             self.chunk_sizes = meta.get("chunk_sizes", [])
             self.resharded = meta.get("resharded", False)
+            self.foreign_events = meta.get("foreign_events", 0)
             if len(self.chunk_sizes) != self.n_chunks - self.first_chunk:
                 # metadata predates size tracking: reconstruct from the chunks
                 # themselves so trim() never mis-accounts legacy storage
@@ -139,6 +151,10 @@ class _PersistedInput:
                     "first_chunk": self.first_chunk,
                     "trimmed_events": self.trimmed_events,
                     "chunk_sizes": self.chunk_sizes,
+                    # rescale bookkeeping must survive a live flush, or the
+                    # NEXT restart would mis-drop this subject's prefix
+                    "resharded": self.resharded,
+                    "foreign_events": self.foreign_events,
                 }
             ),
         )
@@ -396,9 +412,12 @@ class _OperatorSnapshots:
         input_offsets: dict[str, int],
         tick: int,
         n_workers: int,
+        shardmap_version: int | None = None,
     ) -> None:
         """Publish the current generation (single writer — worker/process 0)
-        and garbage-collect the previous one."""
+        and garbage-collect the previous one. ``shardmap_version`` pins which
+        committed shard map placed these shards, so a later O(moved-state)
+        migration can diff exactly from it."""
         g = self.gen
         self.backend.put(
             _MANIFEST,
@@ -409,6 +428,7 @@ class _OperatorSnapshots:
                     "input_offsets": input_offsets,
                     "node_names": node_names,
                     "n_workers": n_workers,
+                    "shardmap_version": shardmap_version,
                 }
             ),
         )
@@ -541,6 +561,17 @@ class Persistence:
         #: (stored workers, current workers) when this restore resharded by
         #: replay instead of restoring positional shards (PATHWAY_ELASTIC)
         self._reshard_restore: tuple[int, int] | None = None
+        #: (old map, new map) when this restore migrates O(moved state) per the
+        #: shard-map diff (PATHWAY_SHARDMAP_MIGRATION) — resolved once, used by
+        #: BOTH the input-log step and the operator-shard step so every process
+        #: takes the same path (the decision is deterministic from shared
+        #: backend state + env, so no extra barrier is needed)
+        self._migrate_plan: tuple | None = None
+        self._migrate_checked = False
+        #: every persisted node of the CURRENT graph supports keyed/solo
+        #: migration — the condition under which elastic input-log trim is
+        #: sound again (a future rescale will never need the full history)
+        self._migratable_graph = False
 
     # called by Runtime once the engine graph is built, before drivers start
     def on_graph_built(self, ctx) -> None:
@@ -614,6 +645,15 @@ class Persistence:
                 )
                 for n in next(iter(self._worker_nodes.values()))
             ]
+            from pathway_tpu import elastic as _elastic2
+
+            self._migratable_graph = (
+                _elastic2.migration_enabled()
+                and getattr(self.runtime, "shardmap", None) is not None
+                and self._nodes_migratable(
+                    next(iter(self._worker_nodes.values()))
+                )
+            )
             self.opsnap = _OperatorSnapshots(
                 self.backend, self.config.snapshot_interval_ms / 1000.0
             )
@@ -631,7 +671,13 @@ class Persistence:
                         "storage or revert the pipeline change"
                     )
                 if self.opsnap.stored_workers() != self._total_workers:
-                    self._elastic_reshard_opsnap()
+                    plan = self._migration_mode()
+                    if plan is not None:
+                        # O(moved-state): keep the manifest offsets so replay
+                        # stays O(suffix), move only re-mapped ranges' shards
+                        offsets = self._elastic_migrate_opsnap(*plan)
+                    else:
+                        self._elastic_reshard_opsnap()
                 else:
                     offsets = dict(self.opsnap.manifest["input_offsets"])
                     self.opsnap.restore(self._worker_nodes)
@@ -703,15 +749,33 @@ class Persistence:
     def _elastic_reshard_inputs(self) -> None:
         """Re-own orphaned partitioned input logs under the new worker count
         (elasticity plane). Runs on every restore while PATHWAY_ELASTIC is
-        enabled; a no-op scan when the layout already matches."""
+        enabled; a no-op scan when the layout already matches.
+
+        Under an O(moved-state) migration (``_migration_mode``) the full
+        key-range rebucket is replaced by :func:`elastic.adopt_orphan_suffixes`
+        — only the orphan workers' log SUFFIXES past the snapshot offsets move
+        (their prefixes are already reflected in the operator shards that
+        migrate), so this step is O(suffix) instead of O(history)."""
         from pathway_tpu import elastic as _elastic
 
         if self._pid == 0:
-            orphans = _elastic.orphan_workers(self.backend, self._total_workers)
-            if orphans:
-                old = max(max(v) for v in orphans.values()) + 1
-                stats = _elastic.reshard_input_logs(self.backend, self._total_workers)
-                _elastic.note_reshard_restore(old, self._total_workers, stats)
+            if self._migration_mode() is not None:
+                manifest = pickle.loads(self.backend.get(_MANIFEST))
+                self._migrate_input_stats = _elastic.adopt_orphan_suffixes(
+                    self.backend,
+                    self._total_workers,
+                    manifest.get("input_offsets", {}),
+                )
+            else:
+                orphans = _elastic.orphan_workers(self.backend, self._total_workers)
+                if orphans:
+                    old = max(max(v) for v in orphans.values()) + 1
+                    stats = _elastic.reshard_input_logs(
+                        self.backend,
+                        self._total_workers,
+                        shard_map=getattr(self.runtime, "shardmap", None),
+                    )
+                    _elastic.note_reshard_restore(old, self._total_workers, stats)
         if self._is_cluster:
             # peers must not wrap inputs until the coordinator's rebucket is
             # durable; symmetric barrier (reshard_enabled is env-driven, so
@@ -719,6 +783,199 @@ class Persistence:
             self.runtime._barrier(
                 ("elastic_reshard", self._pid, {}), lambda reports: {"ok": True}
             )
+
+    def _template_nodes(self) -> list | None:
+        """One worker's node list (graphs align by ``node_index`` across
+        workers AND processes), available before ``_worker_nodes`` is built."""
+        local_workers = getattr(self.runtime, "local_workers", None)
+        if local_workers:
+            return list(next(iter(local_workers.values())).graph.nodes)
+        workers = getattr(self.runtime, "workers", None)
+        if workers:
+            return list(workers[0].graph.nodes)
+        return None
+
+    @staticmethod
+    def _nodes_migratable(template: list, indices: set | None = None) -> bool:
+        """Every (relevant) node supports keyed or solo migration. With
+        ``indices`` — the node positions that actually have stored shards —
+        only those can block; without it (the input-log trim gate, where the
+        future rescale's shard set is unknown) any node that would persist
+        state must support migration."""
+        for n in template:
+            if indices is not None:
+                if n.node_index not in indices:
+                    continue
+            else:
+                try:
+                    if (
+                        not getattr(n, "uses_snapshot_store", False)
+                        and n.snapshot_state() is None
+                    ):
+                        continue  # provably stateless — cannot block
+                except Exception:
+                    pass  # can't prove stateless: require migration support
+            if getattr(n, "uses_snapshot_store", False):
+                # aux chunk stores (index plane) are positional by
+                # construction — no keyed migration yet
+                return False
+            if n.migrate_mode() is None:
+                return False
+        return True
+
+    def _migration_mode(self) -> tuple | None:
+        """``(old map, new map)`` when this restore migrates O(moved state)
+        instead of resharding by replay, else None. The answer is
+        deterministic from shared backend state + env + the (aligned) graph,
+        so every process resolves the same path with no extra barrier."""
+        if self._migrate_checked:
+            return self._migrate_plan
+        self._migrate_checked = True
+        from pathway_tpu import elastic as _elastic
+        from pathway_tpu.internals import shardmap as _shardmap
+        from pathway_tpu.internals.telemetry import record_event
+
+        if not self.operator_mode or not _elastic.migration_enabled():
+            return None
+        new_map = getattr(self.runtime, "shardmap", None)
+        if new_map is None or new_map.n_workers != self._total_workers:
+            return None
+        raw = self.backend.get(_MANIFEST)
+        if raw is None:
+            return None  # nothing persisted: nothing to migrate
+        manifest = pickle.loads(raw)
+        stored = manifest.get("n_workers", 1)
+        if stored == self._total_workers:
+            return None  # same shape: plain positional restore
+        smv = manifest.get("shardmap_version")
+        old_map = (
+            _shardmap.read_shardmap_version(self.backend, smv)
+            if smv is not None
+            else None
+        )
+        if old_map is None or old_map.n_workers != stored:
+            # the previous shape ran without the shard-map plane (or its map
+            # history is gone) — its placement cannot be reconstructed, so the
+            # general replay path must recompute
+            return None
+        template = self._template_nodes()
+        if template is None:
+            return None
+        g = manifest["gen"]
+        indices: set[int] = set()
+        for k in self.backend.list_keys(f"operators/gen_{g:08d}/"):
+            tail = k.rsplit("node_", 1)
+            if len(tail) == 2:
+                try:
+                    indices.add(int(tail[1]))
+                except ValueError:
+                    pass
+        if not self._nodes_migratable(template, indices):
+            record_event(
+                "elastic.migrate_unsupported",
+                old_workers=stored,
+                new_workers=self._total_workers,
+                process_id=self._pid,
+            )
+            return None
+        self._migrate_plan = (old_map, new_map)
+        return self._migrate_plan
+
+    def _elastic_migrate_opsnap(self, old_map, new_map) -> dict:
+        """O(moved-state) restore for a worker-count change: keep the manifest
+        offsets (replay stays O(suffix)!) and rebuild each LOCAL worker's node
+        state from the old generation's shards — positionally for solo nodes,
+        by filtered merge of the shard-map-overlapping old shards for keyed
+        nodes. The old generation is only read, never written: a crash before
+        the next commit re-runs the (idempotent) migration; the next commit's
+        generation GC reclaims it."""
+        import numpy as np
+
+        from pathway_tpu import elastic as _elastic
+        from pathway_tpu.internals import shardmap as _shardmap
+
+        t0 = _time.monotonic()
+        manifest = self.opsnap.manifest
+        g = manifest["gen"]
+        stored = self.opsnap.stored_workers()
+        moved = _shardmap.diff(old_map, new_map)
+        rows_moved = 0
+        bytes_moved = 0
+
+        def entry_count(st: dict) -> int:
+            n = 0
+            for v in st.values():
+                if isinstance(v, dict) and all(
+                    isinstance(x, (int, np.integer)) for x in list(v)[:3]
+                ):
+                    n += len(v)  # key-addressed entries (state/_state dicts)
+            cst = st.get("cstate")
+            if isinstance(cst, dict) and "gk" in cst:
+                n += len(cst["gk"])
+            return n
+
+        for w, nodes in self._worker_nodes.items():
+            overlap = _shardmap.overlap_sources(old_map, new_map, w)
+
+            def keep(keys, _w=w):
+                arr = np.asarray(keys, dtype=np.uint64)
+                return new_map.owner_of_keys(arr) == _w
+
+            for node in nodes:
+                mode = node.migrate_mode()
+                if mode == "solo":
+                    # serial operator: its single shard lives on global worker
+                    # 0 under EVERY shape — positional restore there
+                    if w != 0:
+                        continue
+                    raw = self.backend.get(
+                        f"operators/gen_{g:08d}/worker_{0:03d}"
+                        f"/node_{node.node_index:05d}"
+                    )
+                    if raw is not None:
+                        node.restore_state(pickle.loads(raw))
+                    continue
+                if mode != "keyed":
+                    continue  # no stored shard (guaranteed by _migration_mode)
+                srcs = (
+                    overlap
+                    if getattr(node, "migrate_aligned", True)
+                    else range(stored)
+                )
+                shards: list[dict] = []
+                for ow in srcs:
+                    raw = self.backend.get(
+                        f"operators/gen_{g:08d}/worker_{ow:03d}"
+                        f"/node_{node.node_index:05d}"
+                    )
+                    if raw is None:
+                        continue
+                    st = pickle.loads(raw)
+                    shards.append(st)
+                    if int(ow) != w:
+                        bytes_moved += len(raw)
+                        rows_moved += entry_count(st)
+                if not shards:
+                    continue
+                state = node.migrate_restore(shards, keep)
+                if state is not None:
+                    node.restore_state(state)
+
+        in_stats = getattr(self, "_migrate_input_stats", None)
+        if in_stats is not None:
+            rows_moved += in_stats.rows_moved
+            bytes_moved += in_stats.bytes_moved
+        pause_s = _time.monotonic() - t0
+        _elastic.note_migrate_restore(
+            stored,
+            self._total_workers,
+            _shardmap.moved_fraction(old_map, new_map),
+            rows_moved,
+            bytes_moved,
+            len(moved),
+            pause_s,
+        )
+        return dict(manifest["input_offsets"])
 
     def _elastic_reshard_opsnap(self) -> None:
         """Worker count changed under operator persistence: positional shards
@@ -864,12 +1121,16 @@ class Persistence:
 
     def _trim_inputs(self, offset_of) -> None:
         """Log compaction after a durable operator commit — SUSPENDED while
-        the elasticity plane is enabled: reshard-by-replay needs the full
-        history to recompute state for a new worker count, so elastic runs
-        trade compaction for reshardability (README "Elasticity")."""
+        the elasticity plane is enabled AND the pipeline cannot migrate:
+        reshard-by-replay needs the full history to recompute state for a new
+        worker count, so such runs trade compaction for reshardability
+        (README "Elasticity"). When every persisted node supports keyed/solo
+        migration under the shard map (``_migratable_graph``), a future
+        rescale moves state instead of replaying it, so elastic runs compact
+        again — input logs stay bounded across rescales."""
         from pathway_tpu import elastic as _elastic
 
-        if _elastic.reshard_enabled():
+        if _elastic.reshard_enabled() and not self._migratable_graph:
             return
         for p in self.inputs:
             p.trim(offset_of(p))
@@ -903,8 +1164,13 @@ class Persistence:
         )
         if self._pid == 0:
             gen = self.opsnap.gen
+            sm = getattr(self.runtime, "shardmap", None)
             self.opsnap.commit(
-                self._node_names, decision["offsets"], time, self._total_workers
+                self._node_names,
+                decision["offsets"],
+                time,
+                self._total_workers,
+                shardmap_version=sm.version if sm is not None else None,
             )
             if self.epochs is not None:
                 self.epochs.commit(

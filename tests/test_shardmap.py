@@ -1,0 +1,169 @@
+"""Shard-map plane unit/property tests (r19): the versioned ownership table
+that zero-hop routing and O(moved-state) migration both pivot on.
+
+Property tests walk random scale sequences N -> M -> K (including no-op
+N -> N) and assert, at every version: exactly-one-owner over the whole
+residue space, minimal movement (rebalance moves exactly the quota excess,
+never more), ``diff`` enumerating exactly the moved residues, and
+``overlap_sources`` matching a brute-force owner scan. Placement unification
+is pinned by ``shard_of_keys(keys, n, shard_map=m) == m.owner_of_keys(keys)``
+— the engine, the doors, and the migration all route through the same helper.
+"""
+
+from __future__ import annotations
+
+import random
+
+import numpy as np
+import pytest
+
+from pathway_tpu.internals import shardmap
+from pathway_tpu.internals.keys import SHARD_MASK, shard_of_keys, splitmix64
+from pathway_tpu.internals.shardmap import SHARD_SPACE, ShardMap
+from pathway_tpu.persistence.backends import MemoryBackend
+
+ALL_RESIDUES = np.arange(SHARD_SPACE, dtype=np.int64)
+
+
+def _owner_table(m: ShardMap) -> np.ndarray:
+    """owner of every residue — the brute-force ground truth."""
+    return m.owner_of_residues(ALL_RESIDUES)
+
+
+# ------------------------------------------------------------------ properties
+
+
+def test_initial_map_partitions_space_exactly_once():
+    for n in (1, 2, 3, 5, 7, 16):
+        m = ShardMap.initial(n)
+        m.validate()
+        owners = _owner_table(m)
+        counts = np.bincount(owners, minlength=n)
+        assert counts.sum() == SHARD_SPACE  # every residue owned exactly once
+        assert (counts > 0).all()  # by exactly these n workers
+        assert abs(int(counts.max()) - int(counts.min())) <= 1  # equal split
+
+
+def test_random_scale_walks_exactly_one_owner_and_minimal_movement():
+    rng = random.Random(0xA11CE)
+    for _walk in range(20):
+        m = ShardMap.initial(rng.randint(1, 8))
+        for _step in range(6):
+            new_n = rng.choice([m.n_workers, rng.randint(1, 9)])  # incl. N->N
+            nm = m.rebalance(new_n)
+            nm.validate()
+            old_t, new_t = _owner_table(m), _owner_table(nm)
+            counts = np.bincount(new_t, minlength=new_n)
+            assert counts.sum() == SHARD_SPACE and (counts > 0).all()
+            moved = int((old_t != new_t).sum())
+            if new_n == m.n_workers:
+                assert moved == 0  # no-op reshape moves NOTHING
+            else:
+                # minimal movement: every survivor keeps min(owned, quota)
+                quota = [
+                    SHARD_SPACE // new_n + (1 if w < SHARD_SPACE % new_n else 0)
+                    for w in range(new_n)
+                ]
+                old_counts = np.bincount(
+                    old_t, minlength=max(new_n, m.n_workers)
+                )
+                kept_max = sum(
+                    min(int(old_counts[w]), quota[w]) for w in range(new_n)
+                )
+                assert moved == SHARD_SPACE - kept_max
+            m = nm
+
+
+def test_diff_enumerates_exactly_the_moved_residues():
+    rng = random.Random(7)
+    for _ in range(10):
+        old = ShardMap.initial(rng.randint(1, 6))
+        new = old.rebalance(rng.randint(1, 7))
+        old_t, new_t = _owner_table(old), _owner_table(new)
+        in_diff = np.zeros(SHARD_SPACE, dtype=bool)
+        for s, e, a, b in shardmap.diff(old, new):
+            assert a != b
+            assert (old_t[s:e] == a).all() and (new_t[s:e] == b).all()
+            assert not in_diff[s:e].any()  # segments never overlap
+            in_diff[s:e] = True
+        np.testing.assert_array_equal(in_diff, old_t != new_t)
+        assert shardmap.moved_fraction(old, new) == pytest.approx(
+            in_diff.sum() / SHARD_SPACE
+        )
+
+
+def test_overlap_sources_matches_brute_force_owner_scan():
+    rng = random.Random(99)
+    for _ in range(10):
+        old = ShardMap.initial(rng.randint(1, 7))
+        new = old.rebalance(rng.randint(1, 8))
+        old_t, new_t = _owner_table(old), _owner_table(new)
+        for w in range(new.n_workers):
+            expect = sorted(set(int(o) for o in old_t[new_t == w]))
+            assert shardmap.overlap_sources(old, new, w) == expect
+        # an unmoved worker's overlap is itself plus only the donors of
+        # gained ranges — reads stay O(moved + local)
+        if new.n_workers >= old.n_workers:
+            for w in range(old.n_workers):
+                assert w in shardmap.overlap_sources(old, new, w) or (
+                    old_t == w
+                ).sum() == 0
+
+
+def test_shard_of_keys_unifies_modulo_and_map_placement():
+    keys = np.array([splitmix64(np.uint64(i)) for i in range(512)], dtype=np.uint64)
+    # modulo rule (map off): the ONE formula, byte-for-byte
+    np.testing.assert_array_equal(
+        shard_of_keys(keys, 3), ((keys & SHARD_MASK) % 3).astype(np.int32)
+    )
+    # map on: placement IS the map's answer
+    m = ShardMap.initial(3).rebalance(5)
+    np.testing.assert_array_equal(
+        shard_of_keys(keys, 5, shard_map=m), m.owner_of_keys(keys)
+    )
+    # every key owned by exactly one worker in range
+    owners = shard_of_keys(keys, 5, shard_map=m)
+    assert ((owners >= 0) & (owners < 5)).all()
+
+
+# ------------------------------------------------------------------ backend IO
+
+
+def test_commit_read_roundtrip_and_immutable_history():
+    MemoryBackend.clear("smap-rt")
+    b = MemoryBackend("smap-rt")
+    assert shardmap.read_shardmap(b) is None
+    m0 = shardmap.commit_shardmap(b, ShardMap.initial(2, version=0))
+    m1 = shardmap.commit_shardmap(b, m0.rebalance(3, version=1))
+    got = shardmap.read_shardmap(b)
+    assert got is not None and got.version == 1 and got.n_workers == 3
+    np.testing.assert_array_equal(got.starts, m1.starts)
+    hist0 = shardmap.read_shardmap_version(b, 0)
+    assert hist0 is not None and hist0.n_workers == 2  # history immutable
+
+
+def test_ensure_shardmap_never_reuses_a_version_for_a_new_map():
+    """A cold relaunch at a new shape may arrive with a STALE membership
+    version — the rebalanced map must still get a fresh version or it would
+    overwrite the history entry the persistence manifest pins for its
+    migration diff."""
+    MemoryBackend.clear("smap-fresh")
+    b = MemoryBackend("smap-fresh")
+    first, prev = shardmap.ensure_shardmap(b, 2, version=0, commit=True)
+    assert prev is None and first.version == 0
+    # same shape: stored map reused, nothing committed
+    again, prev = shardmap.ensure_shardmap(b, 2, version=0, commit=True)
+    assert prev is None and again.version == 0
+    # new shape, STALE version 0: must not collide with the stored v0
+    cur, prev = shardmap.ensure_shardmap(b, 3, version=0, commit=True)
+    assert prev is not None and prev.n_workers == 2
+    assert cur.version == 1 and cur.n_workers == 3
+    old = shardmap.read_shardmap_version(b, 0)
+    assert old is not None and old.n_workers == 2  # history survived
+    # derivation is deterministic: a peer deriving WITHOUT commit agrees
+    MemoryBackend.clear("smap-fresh2")
+    b2 = MemoryBackend("smap-fresh2")
+    shardmap.commit_shardmap(b2, ShardMap.initial(2, version=0))
+    peer, _ = shardmap.ensure_shardmap(b2, 3, version=0, commit=False)
+    np.testing.assert_array_equal(peer.starts, cur.starts)
+    np.testing.assert_array_equal(peer.owners, cur.owners)
