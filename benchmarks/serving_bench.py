@@ -839,6 +839,338 @@ def fabric_leg() -> dict:
                 c.kill()
 
 
+# ------------------------------------- leg 6½: replica-served retrieval A/B
+
+REPLICA_CLIENT_THREADS = 6
+REPLICA_REQS_PER_THREAD = 100  # 95/5 read/write mix (every 20th is a write)
+REPLICA_REPS = 4  # even: each mode leads half the reps (order rotation)
+REPLICA_DOCS = 256
+GATE_REPLICA_SCALING = 2.0  # N replica doors vs 1 on read qps (ROADMAP #2)
+
+_REPLICA_CHILD = '''
+import os, sys, threading, time
+import pathway_tpu as pw
+from pathway_tpu.fabric import index_replica
+from pathway_tpu.io.http._server import rest_connector
+from pathway_tpu.stdlib.indexing import BruteForceKnnFactory
+from pathway_tpu.xpacks.llm import DocumentStore
+from pathway_tpu.xpacks.llm.mocks import FakeEmbedder
+from pathway_tpu.xpacks.llm.servers import BaseRestServer
+
+port = int(sys.argv[1]); n_docs = int(sys.argv[2]); stop_file = sys.argv[3]
+
+# one webserver carries both routes: /v1/retrieve (replica-served reads)
+# and /v1/ingest (the 5% write mix, landing in the live index)
+server = BaseRestServer("127.0.0.1", port)
+ing, respond_ing = rest_connector(
+    webserver=server.webserver, route="/v1/ingest",
+    schema=pw.schema_from_types(data=str),
+)
+base = pw.debug.table_from_rows(
+    pw.schema_from_types(data=str),
+    [(f"doc {i:04d} " + " ".join(f"w{(i * 7 + j) % 97}" for j in range(10)),)
+     for i in range(n_docs)],
+)
+store = DocumentStore(
+    base.concat_reindex(ing),
+    retriever_factory=BruteForceKnnFactory(embedder=FakeEmbedder(dimension=16)),
+)
+replica_route = index_replica.maybe_arm("/v1/retrieve", store)
+server.serve(
+    "/v1/retrieve", store.RetrieveQuerySchema, store.retrieve_query,
+    replica_route=replica_route,
+)
+respond_ing(ing.select(result=pw.apply(lambda d: "ok", ing.data)))
+
+def watch():
+    while not os.path.exists(stop_file):
+        time.sleep(0.2)
+    rt = pw.internals.run.current_runtime()
+    if rt is not None:
+        rt.request_stop()
+
+threading.Thread(target=watch, daemon=True).start()
+pw.run(monitoring_level="none", autocommit_duration_ms=50)
+'''
+
+#: closed-loop 95/5 client (subprocess per client, same rationale as
+#: ``_FABRIC_CLIENT``): reads hit /v1/retrieve on its assigned door, every
+#: 20th request writes a fresh doc through /v1/ingest — read latencies,
+#: replica-vs-forward sources and the reported replica lag are collected
+_REPLICA_CLIENT = '''
+import http.client, json, sys, time
+
+door = int(sys.argv[1]); reqs = int(sys.argv[2]); n_docs = int(sys.argv[3])
+seed = int(sys.argv[4]); start_at = float(sys.argv[5])
+hdrs = {"Content-Type": "application/json"}
+conn = http.client.HTTPConnection("127.0.0.1", door, timeout=60)
+
+def post(route, payload):
+    conn.request("POST", route, json.dumps(payload), hdrs)
+    r = conn.getresponse()
+    body = r.read()
+    return (r.status, body, r.getheader("X-Pathway-Fabric") or "",
+            r.getheader("X-Pathway-Replica-Lag-Ms"))
+
+for i in range(6):  # connection + replica path warm, untimed
+    post("/v1/retrieve", {"query": f"doc {(seed * 31 + i) % n_docs:04d}", "k": 3})
+while time.time() < start_at:
+    time.sleep(0.002)
+t_start = time.time(); lats = []; errors = 0
+local = 0; reads = 0; writes = 0; lag_max = 0.0
+for i in range(reqs):
+    t0 = time.perf_counter()
+    try:
+        if i % 20 == 19:
+            status, _b, _s, _l = post(
+                "/v1/ingest", {"data": f"ingest c{seed} i{i} fresh row"}
+            )
+            if status != 200:
+                errors += 1
+            else:
+                writes += 1
+            continue
+        q = f"doc {(seed * 131 + i * 7) % n_docs:04d} w{i % 97}"
+        status, _body, src, lag = post("/v1/retrieve", {"query": q, "k": 3})
+        if status != 200:
+            errors += 1
+            continue
+        reads += 1
+        lats.append(time.perf_counter() - t0)
+        if src.startswith("replica:"):
+            local += 1
+        if lag is not None:
+            lag_max = max(lag_max, float(lag))
+    except Exception:
+        errors += 1
+        try:
+            conn.close()
+        except Exception:
+            pass
+        conn = http.client.HTTPConnection("127.0.0.1", door, timeout=60)
+print(json.dumps({"start": t_start, "end": time.time(), "lats": lats,
+                  "errors": errors, "local": local, "reads": reads,
+                  "writes": writes, "lag_max_ms": lag_max}))
+'''
+
+
+def replica_leg() -> dict:
+    """Read-heavy (95/5) retrieval on the SAME 3-process pod: all clients on
+    one door vs spread across all doors. With the r20 index replicas, the
+    spread mode answers KNN locally at every door, so read qps scales with
+    doors instead of pinning to the owner — ``replica_read_qps_scaling`` is
+    the headline; the write mix keeps the index churning so the reported
+    replica lag is the under-churn number."""
+    import subprocess
+    import tempfile
+    import urllib.request as _urlreq
+
+    tmp = tempfile.mkdtemp(prefix="replica_bench_")
+    script = os.path.join(tmp, "retrieve.py")
+    with open(script, "w") as fh:
+        fh.write(_REPLICA_CHILD)
+    client_script = os.path.join(tmp, "client.py")
+    with open(client_script, "w") as fh:
+        fh.write(_REPLICA_CLIENT)
+    stop_file = os.path.join(tmp, "stop")
+    block = _free_port_run(FABRIC_PROCS + 2 * FABRIC_PROCS + 3)
+    http_port = block
+    first_port = block + FABRIC_PROCS
+    env = dict(os.environ)
+    env.update(
+        PATHWAY_PROCESSES=str(FABRIC_PROCS),
+        PATHWAY_THREADS="1",
+        PATHWAY_FABRIC="on",
+        PATHWAY_BARRIER_TIMEOUT="60",
+        PATHWAY_FIRST_PORT=str(first_port),
+        JAX_PLATFORMS="cpu",
+        PYTHONPATH=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    )
+    children = [
+        subprocess.Popen(
+            [sys.executable, script, str(http_port), str(REPLICA_DOCS), stop_file],
+            env=dict(env, PATHWAY_PROCESS_ID=str(pid)),
+            stdout=subprocess.DEVNULL,
+            stderr=subprocess.STDOUT,
+        )
+        for pid in range(FABRIC_PROCS)
+    ]
+    doors = [http_port + i for i in range(FABRIC_PROCS)]
+    try:
+        for p in doors:
+            _wait_ready(p, timeout=120)
+
+        def retrieve(door: int, query: str):
+            req = _urlreq.Request(
+                f"http://127.0.0.1:{door}/v1/retrieve",
+                data=json.dumps({"query": query, "k": 3}).encode(),
+                headers={"Content-Type": "application/json"},
+            )
+            r = _urlreq.urlopen(req, timeout=90)
+            return r.read(), r.headers.get("X-Pathway-Fabric", "")
+
+        # byte-identity hard gate, polled: bounded staleness means an early
+        # local answer can predate the full corpus landing — wait until all
+        # doors agree (peers serving locally), then hold that as the gate
+        byte_identical = False
+        replicas_serving = False
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            got = [retrieve(p, "doc 0007 w49 w56") for p in doors]
+            byte_identical = len({body for body, _src in got}) == 1
+            replicas_serving = all(
+                src.startswith("replica:") for _body, src in got[1:]
+            )
+            if byte_identical and replicas_serving and json.loads(got[0][0]):
+                break
+            time.sleep(0.5)
+
+        def run_mode(mode: str) -> dict:
+            start_at = time.time() + 1.2  # cover client startup skew
+            clients = []
+            for ci in range(REPLICA_CLIENT_THREADS):
+                door = doors[0] if mode == "single" else doors[ci % FABRIC_PROCS]
+                clients.append(
+                    subprocess.Popen(
+                        [
+                            sys.executable,
+                            client_script,
+                            str(door),
+                            str(REPLICA_REQS_PER_THREAD),
+                            str(REPLICA_DOCS),
+                            str(ci),
+                            str(start_at),
+                        ],
+                        stdout=subprocess.PIPE,
+                        text=True,
+                    )
+                )
+            lats: list[float] = []
+            starts, ends = [], []
+            errors = local = reads = writes = 0
+            lag_max = 0.0
+            for c in clients:
+                out, _ = c.communicate(timeout=300)
+                doc = json.loads(out)
+                lats.extend(doc["lats"])
+                starts.append(doc["start"])
+                ends.append(doc["end"])
+                errors += doc["errors"]
+                local += doc["local"]
+                reads += doc["reads"]
+                writes += doc["writes"]
+                lag_max = max(lag_max, doc["lag_max_ms"])
+            assert errors == 0, f"{errors} failed requests in {mode} mode"
+            wall = max(ends) - min(starts)
+            return {
+                "qps": len(lats) / wall,
+                "p99_ms": _pctile(lats, 0.99) * 1e3,
+                "local": local,
+                "reads": reads,
+                "writes": writes,
+                "lag_max_ms": lag_max,
+            }
+
+        by_mode: dict[str, list[dict]] = {"single": [], "multi": []}
+        for rep in range(REPLICA_REPS):
+            order = ("single", "multi") if rep % 2 == 0 else ("multi", "single")
+            for mode in order:
+                by_mode[mode].append(run_mode(mode))
+        qps_single = max(r["qps"] for r in by_mode["single"])
+        qps_multi = max(r["qps"] for r in by_mode["multi"])
+        multi_reads = sum(r["reads"] for r in by_mode["multi"])
+        multi_local = sum(r["local"] for r in by_mode["multi"])
+        spread = max(
+            max(r["qps"] for r in reps) / max(1e-9, min(r["qps"] for r in reps))
+            for reps in by_mode.values()
+        )
+        return {
+            "processes": FABRIC_PROCS,
+            "client_threads": REPLICA_CLIENT_THREADS,
+            "reqs_per_thread": REPLICA_REQS_PER_THREAD,
+            "reps": REPLICA_REPS,
+            "read_write_mix": "95/5",
+            "byte_identical": byte_identical,
+            "replicas_serving": replicas_serving,
+            "read_qps_single_door": round(qps_single, 1),
+            "read_qps_all_doors": round(qps_multi, 1),
+            "replica_read_qps_scaling": round(qps_multi / qps_single, 3),
+            "p99_single_door_ms": round(
+                statistics.median(r["p99_ms"] for r in by_mode["single"]), 2
+            ),
+            "p99_all_doors_ms": round(
+                statistics.median(r["p99_ms"] for r in by_mode["multi"]), 2
+            ),
+            "multi_local_share": round(multi_local / max(1, multi_reads), 3),
+            "replica_lag_ms_max": round(
+                max(r["lag_max_ms"] for rs in by_mode.values() for r in rs), 1
+            ),
+            "rep_spread": round(spread, 2),
+            "host_cores": os.cpu_count(),
+        }
+    finally:
+        with open(stop_file, "w") as fh:
+            fh.write("stop")
+        for c in children:
+            try:
+                c.wait(timeout=30)
+            except subprocess.TimeoutExpired:
+                c.kill()
+
+
+def replica_gates(rep: dict, out_path: str | None) -> tuple[bool, list[str], list[str]]:
+    """(ok, failures, warnings) for the replica-read A/B. Structural halves
+    (byte identity once converged, peers actually serving locally) are
+    host-independent hard gates; the 2x read-scaling gate downgrades on
+    underpowered/noisy hosts per the r17/r18/r19 precedent — on a 2-core box
+    three doors plus clients are core-bound and the saved hop cannot show up
+    in wall clock."""
+    failures: list[str] = []
+    warnings: list[str] = []
+    ok = True
+    if not rep["byte_identical"]:
+        ok = False
+        failures.append("replica doors returned differing bytes for the same query")
+    if not rep["replicas_serving"] or rep["multi_local_share"] <= 0.0:
+        ok = False
+        failures.append(
+            "replica doors never answered locally — the A/B is not measuring "
+            "replica serving"
+        )
+    scaling = rep["replica_read_qps_scaling"]
+    underpowered = (os.cpu_count() or 1) < FABRIC_PROCS + 1
+    if scaling < GATE_REPLICA_SCALING:
+        msg = (
+            f"replica read scaling {scaling}x vs required {GATE_REPLICA_SCALING}x "
+            f"(single {rep['read_qps_single_door']} qps, all doors "
+            f"{rep['read_qps_all_doors']} qps)"
+        )
+        if underpowered:
+            warnings.append(
+                f"{msg} — downgraded: host has {os.cpu_count()} cores for "
+                f"{FABRIC_PROCS} doors + clients"
+            )
+        elif rep["rep_spread"] > 1.6:
+            warnings.append(f"{msg} — downgraded: noisy host (spread {rep['rep_spread']})")
+        else:
+            ok = False
+            failures.append(msg)
+    prev = _last_committed_metric(["replica_read_qps_scaling"], exclude=out_path)
+    if prev is not None:
+        prev_val, prev_file = prev
+        if scaling < prev_val * 0.7:
+            msg = (
+                f"replica_read_qps_scaling regressed: {scaling} vs {prev_val} in "
+                f"{prev_file} (allowed drop 30%)"
+            )
+            if rep["rep_spread"] > 1.6 or underpowered:
+                warnings.append(f"{msg} — downgraded (noisy/underpowered host)")
+            else:
+                ok = False
+                failures.append(msg)
+    return ok, failures, warnings
+
+
 # ------------------------------------------- leg 6: zero-hop vs owner-hop A/B
 
 ZEROHOP_CLIENTS = 3
@@ -1245,6 +1577,7 @@ def full(n_docs: int = N_DOCS, out_path: str | None = None) -> dict:
         rtrace = request_trace_leg(docs, rng)
         fab = fabric_leg()
         zh = zerohop_leg()
+        rep = replica_leg()
 
         results: dict = {
             "bench": "serving",
@@ -1258,12 +1591,14 @@ def full(n_docs: int = N_DOCS, out_path: str | None = None) -> dict:
                 "request_trace": rtrace,
                 "fabric": fab,
                 "zero_hop": zh,
+                "replica_read": rep,
             },
             # top-level copies for the regression gate + BASELINE tables
             "serving_qps": tput["serving_qps"],
             "serving_latency_speedup_x": lat["speedup_p50_x"],
             "fabric_qps_scaling": fab["fabric_qps_scaling"],
             "zero_hop_speedup": zh["zero_hop_speedup"],
+            "replica_read_qps_scaling": rep["replica_read_qps_scaling"],
         }
         spread = tput["rep_spread"]
         noisy = spread > 1.6
@@ -1300,7 +1635,8 @@ def full(n_docs: int = N_DOCS, out_path: str | None = None) -> dict:
             failures.append("request tracing on vs off answers not byte-identical")
         fab_ok, fab_failures, fab_warnings = fabric_gates(fab, out_path)
         zh_ok, zh_failures, zh_warnings = zerohop_gates(zh, out_path)
-        for w in fab_warnings + zh_warnings:
+        rep_ok, rep_failures, rep_warnings = replica_gates(rep, out_path)
+        for w in fab_warnings + zh_warnings + rep_warnings:
             print(f"WARNING: {w}", file=sys.stderr)
         if not fab_ok:
             gate_ok = False
@@ -1308,6 +1644,9 @@ def full(n_docs: int = N_DOCS, out_path: str | None = None) -> dict:
         if not zh_ok:
             gate_ok = False
             failures.extend(zh_failures)
+        if not rep_ok:
+            gate_ok = False
+            failures.extend(rep_failures)
         if not rtrace["within_budget"]:
             msg = (
                 f"request-trace default-on overhead past {TRACE_OVERHEAD_PCT}%: "
@@ -1390,6 +1729,31 @@ def fabric_only(out_path: str | None = None) -> dict:
     return results
 
 
+def replica_only(out_path: str | None = None) -> dict:
+    """Just the replica-served retrieval leg (r20): emits a BENCH json
+    carrying ``replica_read_qps_scaling`` plus the under-churn replica lag
+    for the regression chain without re-running the single-process legs."""
+    rep = replica_leg()
+    results: dict = {
+        "bench": "serving_replica",
+        "serving": {"replica_read": rep},
+        "replica_read_qps_scaling": rep["replica_read_qps_scaling"],
+        "replica_lag_ms_max": rep["replica_lag_ms_max"],
+    }
+    ok, failures, warnings = replica_gates(rep, out_path)
+    for w in warnings:
+        print(f"WARNING: {w}", file=sys.stderr)
+    results["gate_ok"] = ok
+    if not ok:
+        print(json.dumps(results))
+        for f in failures:
+            print(f"GATE FAILURE: {f}", file=sys.stderr)
+        if os.environ.get("BENCH_MODE") == "1":
+            sys.exit(1)
+        print("WARNING: gate failures above (hard-fail under BENCH_MODE=1)", file=sys.stderr)
+    return results
+
+
 if __name__ == "__main__":
     args = sys.argv[1:]
     out_path = None
@@ -1405,6 +1769,9 @@ if __name__ == "__main__":
     if "--fabric-only" in args:
         args.remove("--fabric-only")
         res = fabric_only(out_path=out_path)
+    elif "--replica-only" in args:
+        args.remove("--replica-only")
+        res = replica_only(out_path=out_path)
     else:
         res = full(n, out_path=out_path)
     line = json.dumps(res)

@@ -11,6 +11,11 @@ per process after the dataflow builds:
 - **replicas** (``replica.py``): ``pw.io.http.serve_table`` routes answer
   read-only lookups locally from a changelog-fed replica with bounded,
   measured staleness (``pathway_fabric_replica_lag_seconds``);
+- **index replicas** (``index_replica.py``): ``/v1/retrieve``-style KNN
+  routes answer locally at every door from a changelog-fed replica INDEX
+  within ``PATHWAY_REPLICA_MAX_STALENESS_MS`` (``pathway_replica_lag_seconds``,
+  ``pathway_replica_index_rows``), falling back to the owner forward when
+  stale — read qps scales with doors instead of pinning to the owner;
 - **limits** (``limits.py``): per-route token buckets and API-key auth run
   at every door (the coordinator's included — those two work without the
   fabric and without a cluster).
@@ -24,7 +29,8 @@ from __future__ import annotations
 
 from typing import Any
 
-from pathway_tpu.fabric import limits, replica, transport  # noqa: F401
+from pathway_tpu.fabric import index_replica, limits, replica, transport  # noqa: F401
+from pathway_tpu.fabric.index_replica import ReplicaIndex  # noqa: F401
 from pathway_tpu.fabric.limits import ApiKeyGuard, TokenBucket  # noqa: F401
 from pathway_tpu.fabric.replica import ReplicaStore, serve_table  # noqa: F401
 from pathway_tpu.fabric.transport import FabricUnavailable  # noqa: F401
@@ -70,11 +76,13 @@ def status(runtime: Any) -> dict | None:
     if _plane is not None and _plane.runtime is runtime:
         return _plane.status()
     routes = replica.live_table_routes(runtime)
-    if not routes:
+    iroutes = index_replica.live_index_routes(runtime)
+    if not routes and not iroutes:
         return None
     return {
         "enabled": False,
         "replica": {t.route: t.replica_snapshot() for t in routes},
+        "index": {r.route: r.replica_snapshot() for r in iroutes},
     }
 
 
@@ -121,6 +129,61 @@ def prometheus_lines(runtime: Any) -> list[str]:
             lines.append(
                 f"pathway_fabric_replica_fallback_total{{{label}}} {t.fallbacks}"
             )
+    iroutes = index_replica.live_index_routes(runtime)
+    if iroutes:
+        plane = _plane if _plane is not None and _plane.runtime is runtime else None
+        n_proc = plane.n_proc if plane is not None else None
+        series = [
+            (
+                "pathway_replica_lag_seconds",
+                "gauge",
+                "Worst-peer staleness of the local replica index (absent while unsynced)",
+            ),
+            (
+                "pathway_replica_index_rows",
+                "gauge",
+                "Rows held by the local replica index",
+            ),
+            (
+                "pathway_replica_local_answers_total",
+                "counter",
+                "Retrieval requests answered from the local replica index",
+            ),
+            (
+                "pathway_replica_fallback_total",
+                "counter",
+                "Retrieval requests forwarded to the owner (stale/unsynced/unanswerable)",
+            ),
+            (
+                "pathway_replica_gaps_total",
+                "counter",
+                "Changelog sequence gaps detected (each triggers a snapshot resync)",
+            ),
+            (
+                "pathway_replica_resyncs_total",
+                "counter",
+                "Snapshot resyncs completed against peer slices",
+            ),
+        ]
+        snaps = [(r, r.replica_snapshot(n_proc)) for r in iroutes]
+        keys = {
+            "pathway_replica_index_rows": "rows",
+            "pathway_replica_local_answers_total": "local_answers",
+            "pathway_replica_fallback_total": "fallbacks",
+            "pathway_replica_gaps_total": "gaps_total",
+            "pathway_replica_resyncs_total": "resyncs_total",
+        }
+        for name, mtype, help_text in series:
+            lines.append(f"# HELP {name} {help_text}")
+            lines.append(f"# TYPE {name} {mtype}")
+            for r, snap in snaps:
+                label = f'route="{escape_label_value(r.route)}"'
+                if name == "pathway_replica_lag_seconds":
+                    lag = snap.get("lag_s")
+                    if lag is not None:
+                        lines.append(f"{name}{{{label}}} {round(lag, 6)}")
+                else:
+                    lines.append(f"{name}{{{label}}} {snap.get(keys[name], 0)}")
     if _plane is not None and _plane.runtime is runtime:
         lines.append(
             "# HELP pathway_fabric_forward_errors_total Forwards that failed at the fabric transport"
@@ -140,6 +203,8 @@ def prometheus_lines(runtime: Any) -> list[str]:
 __all__ = [
     "ApiKeyGuard",
     "FabricUnavailable",
+    "ReplicaIndex",
+    "index_replica",
     "ReplicaStore",
     "TokenBucket",
     "current",

@@ -49,12 +49,14 @@ every process executes the same program) into a pod-wide serving surface:
 from __future__ import annotations
 
 import asyncio
+import sys
 import threading
 import time as _time
 from typing import Any
 
 import numpy as np
 
+from pathway_tpu.fabric import index_replica as _ireplica
 from pathway_tpu.fabric import replica as _replica
 from pathway_tpu.fabric.transport import FabricNode, FabricUnavailable
 from pathway_tpu.internals.telemetry import record_event
@@ -90,6 +92,14 @@ class FabricPlane:
         self.doors: list[Any] = []
         self._route_states: dict[str, Any] = {}
         self._table_routes: dict[str, _replica.TableRoute] = {}
+        #: replica-served retrieval (r20): per-route changelog-fed index
+        #: replicas; every door answers KNN locally within the staleness bound
+        self._index_routes: dict[str, Any] = {}
+        self.replica_max_staleness_s = cfg.replica_max_staleness_ms / 1000.0
+        self._memo_share = cfg.replica_memo_share == "on"
+        self.memo_casts_total = 0
+        self.memo_entries_out = 0
+        self.memo_entries_in = 0
         self._loop: asyncio.AbstractEventLoop | None = None
         self._outbox: dict[str, list] = {}
         self._outbox_lock = threading.Lock()
@@ -111,9 +121,16 @@ class FabricPlane:
                 self._route_states[rs.route] = rs
         for tr in _replica.live_table_routes():
             self._table_routes[tr.route] = tr
+        for ir in _ireplica.live_index_routes():
+            self._index_routes[ir.route] = ir
+            if ir.replica is not None:
+                # every process authors the changelog slice for the doc keys
+                # the engine's keyed exchange placed on it
+                ir.replica.self_src = self.pid
         self.node.req_handlers["serve"] = self._handle_serve
         self.node.req_handlers["table_lookup"] = self._handle_table_lookup
         self.node.req_handlers["replica_snapshot"] = self._handle_replica_snapshot
+        self.node.req_handlers["index_snapshot"] = self._handle_index_snapshot
         self.node.cast_handlers["replica"] = self._handle_replica_cast
         self.node.cast_handlers["wakeup"] = self._handle_wakeup
         if self.shardmap is not None:
@@ -150,11 +167,22 @@ class FabricPlane:
                             self._resync(tr, wait=False, src=peer)
                 else:
                     self._resync(tr, wait=False)
+        # index replicas are all-to-all regardless of ownership mode (every
+        # process authors its doc shard's slice): pull each peer's slice now
+        # to catch up after a restart; a fresh pod converges via first casts
+        # (every slice starts at seq 0, so there is no gap to detect)
+        for ir in self._index_routes.values():
+            if ir.replica is None:
+                continue
+            for peer in range(self.n_proc):
+                if peer != self.pid:
+                    self._resync_index(ir, peer, wait=False)
         record_event(
             "fabric.installed",
             process_id=self.pid,
             routes=len(self._route_states),
             tables=len(self._table_routes),
+            index_routes=len(self._index_routes),
             doors=len(self.doors),
         )
 
@@ -204,7 +232,14 @@ class FabricPlane:
                     # the fabric header asserting no forward hop happened
                     handler = self._make_zerohop_handler(_handler)
                 else:
-                    handler = self._make_forward_handler(meta["serving"])
+                    rs = meta["serving"]
+                    ir = self._index_routes.get(route)
+                    if ir is not None and ir.state is rs:
+                        # replica-served retrieval: answer KNN from the local
+                        # changelog-fed index, forward when stale/resyncing
+                        handler = self._make_retrieve_handler(ir, rs)
+                    else:
+                        handler = self._make_forward_handler(rs)
                 door._add_route(route, list(methods), handler, meta)
             door.start()
             self.doors.append(door)
@@ -248,91 +283,198 @@ class FabricPlane:
                     return web.json_response({"error": str(e)}, status=400)
             values = S.build_row_values(rs, payload)
             arrival_ns = _time.time_ns()
-            # re-check the budget under the lock AT the point it grows: any
-            # number of handlers can suspend in extract_payload between the
-            # arrival-time try_admit and here (the coordinator handler's
-            # registration-lock discipline, applied to fwd_inflight)
-            with rs.lock:
-                if rs.closed:
-                    shed_reason = "shutting_down"
-                elif len(rs.futures) + rs.fwd_inflight >= rs.max_inflight:
-                    shed_reason = "max_inflight"
-                else:
-                    shed_reason = None
-                    rs.fwd_inflight += 1
-            if shed_reason is not None:
-                return self._shed_web(rs, shed_reason)
-            key = S.mint_request_key()
-            rp = _req_trace.current()
-            request_id = rp.begin(key, rs.route, arrival_ns) if rp is not None else None
-            rs.forwarded_out_total += 1
-            t0 = _time.time_ns()
-            loop = asyncio.get_running_loop()
-            try:
-                status, body, hdrs = await loop.run_in_executor(
-                    None,
-                    lambda: self.node.call(
-                        self.owner_pid,
-                        "serve",
-                        {
-                            "route": rs.route,
-                            "key": key,
-                            "values": values,
-                            "arrival_ns": arrival_ns,
-                        },
-                        self.timeout,
-                    ),
-                )
-            except FabricUnavailable as e:
-                self.forward_errors_total += 1
-                if rp is not None:
-                    rp.complete(key, "error")
-                return web.json_response(
-                    {"error": "fabric forward failed", "reason": str(e)},
-                    status=503,
-                )
-            except asyncio.CancelledError:
-                # client disconnected mid-forward (doors run with
-                # handler_cancellation=True): the registered flight record
-                # must not leak in the live table (it would pin plane.hot
-                # forever) — the owner still answers and cleans up its side
-                if rp is not None:
-                    rp.complete(key, "cancelled")
-                raise
-            finally:
-                with rs.lock:
-                    rs.fwd_inflight -= 1
-            t1 = _time.time_ns()
-            headers = dict(hdrs or {})
-            if request_id is not None:
-                headers["X-Pathway-Request-Id"] = request_id
-            headers["X-Pathway-Fabric"] = f"forwarded:p{self.owner_pid}"
-            if rp is not None:
-                rp.note_boundary(
-                    key, "fabric/forward", t0, t1, {"owner": self.owner_pid}
-                )
-                label = (
-                    "ok"
-                    if status == 200
-                    else "timeout"
-                    if status == 504
-                    else "shed"
-                    if status in (429, 503)
-                    else "error"
-                )
-                rp.complete(key, label, t1, _time.time_ns())
-            if status == 200:
-                # the OWNER's resolution pass already counted this response
-                # (responses_total is where-the-answer-was-computed, so the
-                # pod rollup stays exact); the ingress door keeps the
-                # client-observed latency, which includes the forward hop
-                rs.latency.observe((t1 - arrival_ns) / 1e9)
-            return web.Response(
-                text=body,
-                status=status,
-                content_type="application/json",
-                headers=headers,
+            return await self._forward_values(rs, values, arrival_ns)
+
+        return handler
+
+    async def _forward_values(self, rs: Any, values: tuple, arrival_ns: int):
+        """Forward one validated request row to the owning process and relay
+        its answer — the post-gauntlet core of :meth:`_make_forward_handler`,
+        shared with the replica-served retrieval path's stale fallback."""
+        import aiohttp.web as web
+
+        from pathway_tpu.io.http import _server as S
+        from pathway_tpu.observability import requests as _req_trace
+
+        # re-check the budget under the lock AT the point it grows: any
+        # number of handlers can suspend in extract_payload between the
+        # arrival-time try_admit and here (the coordinator handler's
+        # registration-lock discipline, applied to fwd_inflight)
+        with rs.lock:
+            if rs.closed:
+                shed_reason = "shutting_down"
+            elif len(rs.futures) + rs.fwd_inflight >= rs.max_inflight:
+                shed_reason = "max_inflight"
+            else:
+                shed_reason = None
+                rs.fwd_inflight += 1
+        if shed_reason is not None:
+            return self._shed_web(rs, shed_reason)
+        key = S.mint_request_key()
+        rp = _req_trace.current()
+        request_id = rp.begin(key, rs.route, arrival_ns) if rp is not None else None
+        rs.forwarded_out_total += 1
+        t0 = _time.time_ns()
+        loop = asyncio.get_running_loop()
+        try:
+            status, body, hdrs = await loop.run_in_executor(
+                None,
+                lambda: self.node.call(
+                    self.owner_pid,
+                    "serve",
+                    {
+                        "route": rs.route,
+                        "key": key,
+                        "values": values,
+                        "arrival_ns": arrival_ns,
+                    },
+                    self.timeout,
+                ),
             )
+        except FabricUnavailable as e:
+            self.forward_errors_total += 1
+            if rp is not None:
+                rp.complete(key, "error")
+            return web.json_response(
+                {"error": "fabric forward failed", "reason": str(e)},
+                status=503,
+            )
+        except asyncio.CancelledError:
+            # client disconnected mid-forward (doors run with
+            # handler_cancellation=True): the registered flight record
+            # must not leak in the live table (it would pin plane.hot
+            # forever) — the owner still answers and cleans up its side
+            if rp is not None:
+                rp.complete(key, "cancelled")
+            raise
+        finally:
+            with rs.lock:
+                rs.fwd_inflight -= 1
+        t1 = _time.time_ns()
+        headers = dict(hdrs or {})
+        if request_id is not None:
+            headers["X-Pathway-Request-Id"] = request_id
+        headers["X-Pathway-Fabric"] = f"forwarded:p{self.owner_pid}"
+        if rp is not None:
+            rp.note_boundary(
+                key, "fabric/forward", t0, t1, {"owner": self.owner_pid}
+            )
+            label = (
+                "ok"
+                if status == 200
+                else "timeout"
+                if status == 504
+                else "shed"
+                if status in (429, 503)
+                else "error"
+            )
+            rp.complete(key, label, t1, _time.time_ns())
+        if status == 200:
+            # the OWNER's resolution pass already counted this response
+            # (responses_total is where-the-answer-was-computed, so the
+            # pod rollup stays exact); the ingress door keeps the
+            # client-observed latency, which includes the forward hop
+            rs.latency.observe((t1 - arrival_ns) / 1e9)
+        return web.Response(
+            text=body,
+            status=status,
+            content_type="application/json",
+            headers=headers,
+        )
+
+    # -------------------------------------------------- replica-served retrieval
+    def _replica_unready(self, ir: Any) -> str | None:
+        """Why this door must forward instead of answering from its replica
+        index, or None when the replica is serveable. Never answer past the
+        bound: staleness is measured against the WORST peer slice — a replica
+        is only as fresh as its most-lagged source."""
+        rep = ir.replica
+        if rep is None or ir.composite:
+            return "unarmed"
+        if not rep.self_authoritative:
+            # this process restored from an operator snapshot: its own slice
+            # can't be re-derived, so its answers (and its snapshot RPC) are
+            # off until fresh ops rebuild authority
+            return "restored"
+        if any(
+            isinstance(tok, tuple) and len(tok) == 3 and tok[:2] == ("ix", ir.route)
+            for tok in self._resyncing
+        ):
+            return "resync"
+        lag = rep.remote_lag_s(self.n_proc)
+        if lag is None:
+            return "never_synced"
+        if lag > self.replica_max_staleness_s:
+            return "stale"
+        return None
+
+    def _make_retrieve_handler(self, ir: Any, rs: Any):
+        import aiohttp.web as web
+
+        from pathway_tpu.io.http import _server as S
+        from pathway_tpu.observability import requests as _req_trace
+
+        async def handler(request: "web.Request") -> "web.Response":
+            rs.requests_total += 1
+            gated = S.gate_check(rs, request.headers)
+            if gated is not None:
+                status, body, hdrs = gated
+                return web.json_response(body, status=status, headers=hdrs or None)
+            shed = rs.try_admit()
+            if shed is not None:
+                return self._shed_web(rs, shed)
+            payload = await S.extract_payload(rs, request)
+            if rs.request_validator is not None:
+                try:
+                    rs.request_validator(payload)
+                except Exception as e:
+                    rs.errors_total += 1
+                    return web.json_response({"error": str(e)}, status=400)
+            values = S.build_row_values(rs, payload)
+            arrival_ns = _time.time_ns()
+            reason = self._replica_unready(ir)
+            if reason is None:
+                vals = dict(zip(rs.schema_columns, values))
+                key = S.mint_request_key()
+                rp = _req_trace.current()
+                request_id = (
+                    rp.begin(key, rs.route, arrival_ns) if rp is not None else None
+                )
+                loop = asyncio.get_running_loop()
+                res = await loop.run_in_executor(
+                    None, lambda: _ireplica.local_retrieve_response(ir, vals)
+                )
+                if res is not None:
+                    body, spans = res
+                    t1 = _time.time_ns()
+                    lag = ir.replica.remote_lag_s(self.n_proc) or 0.0
+                    headers = {
+                        "X-Pathway-Fabric": f"replica:p{self.pid}",
+                        "X-Pathway-Replica-Lag-Ms": str(round(lag * 1e3, 1)),
+                    }
+                    if request_id is not None:
+                        headers["X-Pathway-Request-Id"] = request_id
+                    if rp is not None:
+                        for name, s0, s1, attrs in spans:
+                            rp.note_boundary(key, name, s0, s1, attrs)
+                        rp.complete(key, "ok", t1, _time.time_ns())
+                    ir.local_answers += 1
+                    rs.responses_total += 1
+                    rs.latency.observe((t1 - arrival_ns) / 1e9)
+                    return web.Response(
+                        text=body,
+                        status=200,
+                        content_type="application/json",
+                        headers=headers,
+                    )
+                # unanswerable locally (async embedder, payload-less rows, …):
+                # release the flight record and take the forward hop
+                reason = "unanswerable"
+                if rp is not None:
+                    rp.drop(key)
+            ir.fallbacks += 1
+            ir.fallback_reasons[reason] = ir.fallback_reasons.get(reason, 0) + 1
+            return await self._forward_values(rs, values, arrival_ns)
 
         return handler
 
@@ -635,35 +777,62 @@ class FabricPlane:
         return None
 
     def on_tick_done(self, tick: int) -> None:
-        """Owner: broadcast pending changelog batches — or, at least every
-        ``_FRONTIER_INTERVAL_S``, an empty frontier stamp so replica lag
-        keeps measuring freshness while tables are idle. Shard-map mode:
-        EVERY process is the owner of its slice, so every process casts."""
-        if not self._table_routes:
-            return
-        if self.shardmap is None and self.pid != self.owner_pid:
+        """Tick-end cast: pending table changelog batches (owner only in r18
+        mode, every process under the shard map), this process's INDEX
+        changelog slice (always all-to-all — doc rows shard by key, so every
+        process authors ops), freshly-encoded memo entries — or, at least
+        every ``_FRONTIER_INTERVAL_S``, an empty frontier stamp so replica
+        lag keeps measuring freshness while the pipeline is idle."""
+        has_tables = bool(self._table_routes) and (
+            self.shardmap is not None or self.pid == self.owner_pid
+        )
+        has_index = bool(self._index_routes)
+        if not has_tables and not has_index:
             return
         now = _time.time()
-        with self._outbox_lock:
-            outbox, self._outbox = self._outbox, {}
-        if not outbox and now - self._last_cast < _FRONTIER_INTERVAL_S:
+        outbox: dict[str, Any] = {}
+        if has_tables:
+            with self._outbox_lock:
+                outbox, self._outbox = self._outbox, {}
+        index_pending = has_index and any(
+            ir.outbox_pending() for ir in self._index_routes.values()
+        )
+        memo_out = self._drain_memo_out() if self._memo_share else None
+        if (
+            not outbox
+            and not index_pending
+            and not memo_out
+            and now - self._last_cast < _FRONTIER_INTERVAL_S
+        ):
             return
         self._last_cast = now
-        tables = {}
-        for route, troute in self._table_routes.items():
-            ent = outbox.get(route)
-            tables[route] = {
-                "deltas": ent["deltas"] if ent else [],
-                "prev_seq": ent["prev_seq"] if ent else None,
-                "seq": troute.store.seq,
-            }
-            troute.casts_out += 1
-        payload = {
+        payload: dict[str, Any] = {
             "ts": now,
             "mv": self._membership_version(),
-            "tables": tables,
             "src": self.pid,
         }
+        if has_tables:
+            tables = {}
+            for route, troute in self._table_routes.items():
+                ent = outbox.get(route)
+                tables[route] = {
+                    "deltas": ent["deltas"] if ent else [],
+                    "prev_seq": ent["prev_seq"] if ent else None,
+                    "seq": troute.store.seq,
+                }
+                troute.casts_out += 1
+            payload["tables"] = tables
+        if has_index:
+            index = {}
+            for route, ir in self._index_routes.items():
+                ops, prev, seq = ir.drain_ops()
+                index[route] = {"ops": ops, "prev_seq": prev, "seq": seq}
+                ir.casts_out += 1
+            payload["index"] = index
+        if memo_out:
+            payload["memo"] = memo_out
+            self.memo_casts_total += 1
+            self.memo_entries_out += sum(len(v) for v in memo_out.values())
         for peer in range(self.n_proc):
             if peer != self.pid:
                 self.node.cast(peer, "replica", payload, connect_timeout=1.0)
@@ -716,6 +885,35 @@ class FabricPlane:
                 if seq > store.seq:
                     self._resync(troute, wait=False)
                 store.frontier(seq, ts)
+        if src is not None:
+            s = int(src)
+            for route, entry in (payload.get("index") or {}).items():
+                ir = self._index_routes.get(route)
+                rep = ir.replica if ir is not None else None
+                if rep is None or s == rep.self_src:
+                    continue
+                ops = entry.get("ops") or []
+                seq = int(entry.get("seq") or 0)
+                if ops:
+                    prev = int(entry.get("prev_seq") or 0)
+                    if prev == 0 and seq < rep.src_seq.get(s, 0):
+                        # the source RESTARTED: its counter reset below the
+                        # position we hold, which would wedge gap detection
+                        # (every future seq looks "old") — rewind our cursor
+                        # and let the ops + next resync converge the slice
+                        rep.reset_src(s)
+                    if rep.src_gap(s, prev):
+                        rep.gaps_total += 1
+                        self._resync_index(ir, s, wait=False)
+                    rep.apply_ops(s, ops, seq, ts)
+                else:
+                    if seq > rep.src_seq.get(s, 0):
+                        rep.gaps_total += 1
+                        self._resync_index(ir, s, wait=False)
+                    rep.frontier_from(s, seq, ts)
+        memo = payload.get("memo")
+        if memo:
+            self._apply_memo_in(memo)
 
     def _resync(
         self, troute: _replica.TableRoute, wait: bool, src: int | None = None
@@ -761,6 +959,81 @@ class FabricPlane:
         else:
             threading.Thread(target=pull, daemon=True).start()
 
+    # ----------------------------------------------------- index replica feed
+    def _handle_index_snapshot(self, payload: dict, reply) -> None:
+        ir = self._index_routes.get(payload.get("route"))
+        rep = ir.replica if ir is not None else None
+        if rep is None or not rep.self_authoritative:
+            # restored-from-snapshot processes can't vouch for their slice
+            # (ops were never re-derived): answering would hand the peer a
+            # silently-empty slice it would then serve from — refuse instead
+            reply(None)
+            return
+        rows, seq, ts = rep.self_slice()
+        reply({"rows": rows, "seq": seq, "ts": ts, "src": self.pid})
+
+    def _resync_index(self, ir: Any, src: int, wait: bool = False) -> None:
+        """Pull one peer's authoritative index slice (thread — never on the
+        transport recv loop); convergent under concurrent op casts."""
+        rep = ir.replica
+        if rep is None:
+            return
+        token = ("ix", ir.route, src)
+        if token in self._resyncing:
+            return
+        self._resyncing.add(token)
+
+        def pull() -> None:
+            try:
+                snap = self.node.call(
+                    src,
+                    "index_snapshot",
+                    {"route": ir.route},
+                    timeout=min(5.0, self.timeout),
+                )
+                if snap is not None:
+                    rep.install_slice(
+                        int(snap.get("src", src)),
+                        snap["rows"],
+                        snap["seq"],
+                        snap["ts"],
+                    )
+                    rep.resyncs_total += 1
+                else:
+                    # the peer disclaimed its slice (restored, not yet
+                    # re-authoritative): poison it so lag reads None and the
+                    # route forwards until fresh ops arrive from that peer
+                    rep.poison(src)
+            except FabricUnavailable:
+                pass  # stays unsynced; the route keeps forwarding
+            finally:
+                self._resyncing.discard(token)
+
+        if wait:
+            pull()
+        else:
+            threading.Thread(target=pull, daemon=True).start()
+
+    # --------------------------------------------------------- shared memo tier
+    def _drain_memo_out(self) -> dict | None:
+        """Pop locally-encoded query embeddings for the cast. sys.modules
+        gate: the fabric must not import xpacks — no embedders module loaded
+        means no memoizing embedders exist."""
+        mod = sys.modules.get("pathway_tpu.xpacks.llm.embedders")
+        if mod is None:
+            return None
+        out = mod.drain_shared_memo(limit=64)
+        return out or None
+
+    def _apply_memo_in(self, memo: dict) -> None:
+        mod = sys.modules.get("pathway_tpu.xpacks.llm.embedders")
+        if mod is None:
+            return
+        n = 0
+        for fp, entries in memo.items():
+            n += mod.apply_shared_memo(fp, entries)
+        self.memo_entries_in += n
+
     # ------------------------------------------------------------------- status
     def status(self) -> dict[str, Any]:
         return {
@@ -784,6 +1057,16 @@ class FabricPlane:
             "replica": {
                 route: troute.replica_snapshot()
                 for route, troute in sorted(self._table_routes.items())
+            },
+            "index": {
+                route: ir.replica_snapshot(self.n_proc)
+                for route, ir in sorted(self._index_routes.items())
+            },
+            "memo_share": {
+                "enabled": self._memo_share,
+                "casts": self.memo_casts_total,
+                "entries_out": self.memo_entries_out,
+                "entries_in": self.memo_entries_in,
             },
         }
 

@@ -517,6 +517,54 @@ class PathwayConfig:
             raise ValueError(f"PATHWAY_FABRIC_TIMEOUT must be > 0, got {v}")
         return v
 
+    # ---- replica-served retrieval (pathway_tpu/fabric/index_replica) --------
+    @property
+    def replica(self) -> str:
+        """Replica-served retrieval master switch: ``on`` (default — with the
+        fabric live on a cluster run, every process replays the index
+        changelog into a local replica index and its front door answers
+        ``/v1/retrieve`` locally within ``PATHWAY_REPLICA_MAX_STALENESS_MS``,
+        falling back to owner-forwarding when stale or resyncing) or ``off``
+        (every retrieval pays the r18 owner hop; the pre-r20 behavior byte
+        for byte). No-op without ``PATHWAY_FABRIC=on`` or on single-process
+        runs."""
+        raw = os.environ.get("PATHWAY_REPLICA", "on").strip().lower()
+        if raw in ("1", "true", "yes", "on", ""):
+            return "on"
+        if raw in ("0", "false", "no", "off"):
+            return "off"
+        raise ValueError(f"PATHWAY_REPLICA must be on/off, got {raw!r}")
+
+    @property
+    def replica_max_staleness_ms(self) -> float:
+        """Replica-index freshness bound: a door answers ``/v1/retrieve``
+        from its local replica index only while every peer slice's changelog
+        lag is at most this; a staler (or never-synced, or resyncing) replica
+        forwards to the owner instead — counted, never silently stale past
+        the bound."""
+        v = _env_float("PATHWAY_REPLICA_MAX_STALENESS_MS", 2000.0)
+        if v <= 0:
+            raise ValueError(
+                f"PATHWAY_REPLICA_MAX_STALENESS_MS must be > 0, got {v}"
+            )
+        return v
+
+    @property
+    def replica_memo_share(self) -> str:
+        """Pod-wide query-embedding memo sharing: ``on`` (default — each
+        process piggybacks its freshly-encoded memo entries on the replica
+        cast so a pod-wide hot query set embeds once; peers insert them into
+        their own embedder memos) or ``off`` (the r14 memo stays strictly
+        per-process). No-op without a fabric or with unmemoized embedders."""
+        raw = os.environ.get("PATHWAY_REPLICA_MEMO_SHARE", "on").strip().lower()
+        if raw in ("1", "true", "yes", "on", ""):
+            return "on"
+        if raw in ("0", "false", "no", "off"):
+            return "off"
+        raise ValueError(
+            f"PATHWAY_REPLICA_MEMO_SHARE must be on/off, got {raw!r}"
+        )
+
     # ---- shard-map plane (internals/shardmap) ------------------------------
     @property
     def shardmap(self) -> str:
@@ -867,6 +915,9 @@ class PathwayConfig:
                 "fabric_port_stride",
                 "fabric_max_staleness_ms",
                 "fabric_timeout",
+                "replica",
+                "replica_max_staleness_ms",
+                "replica_memo_share",
                 "shardmap",
                 "shardmap_migration",
                 "monitoring_server",

@@ -190,6 +190,16 @@ def run_stats(runtime) -> dict[str, Any]:
     fabric = _fabric.status(runtime)
     if fabric is not None:
         stats["fabric"] = fabric
+    # embedding memo counters (exact hits/misses/evictions + the pod-wide
+    # shared tier) — sys.modules gate: no xpacks import unless the pipeline
+    # already made one
+    import sys as _sys
+
+    _emb = _sys.modules.get("pathway_tpu.xpacks.llm.embedders")
+    if _emb is not None:
+        memo = _emb.memo_stats()
+        if memo:
+            stats["embedder_memo"] = memo
     return stats
 
 
@@ -340,6 +350,12 @@ def prometheus_text(runtime) -> str:
     from pathway_tpu import fabric as _fabric
 
     lines.extend(_fabric.prometheus_lines(runtime))
+    # ---- embedding memo (hit ratio + shared tier) ---------------------------
+    import sys as _sys
+
+    _emb = _sys.modules.get("pathway_tpu.xpacks.llm.embedders")
+    if _emb is not None:
+        lines.extend(_emb.memo_prometheus_lines())
     # ---- per-operator row-level error counters ------------------------------
     from pathway_tpu.internals import error_log as _error_log
 

@@ -85,7 +85,26 @@ def local_summary(runtime) -> dict[str, Any]:
     serving = _rest_serve.serving_heartbeat_summary(runtime)
     if serving is not None:
         summary["serving"] = serving
+    # replica-served retrieval: this door's per-route index-replica health
+    # (rows, worst-peer lag, local answers vs fallbacks, gap/resync counts)
+    from pathway_tpu.fabric import index_replica as _index_replica
+
+    fplane = _get_fabric_plane(runtime)
+    replica_index = _index_replica.heartbeat_summary(
+        runtime, fplane.n_proc if fplane is not None else None
+    )
+    if replica_index is not None:
+        summary["replica_index"] = replica_index
     return summary
+
+
+def _get_fabric_plane(runtime):
+    from pathway_tpu import fabric as _fabric
+
+    plane = _fabric.current()
+    if plane is not None and plane.runtime is runtime:
+        return plane
+    return None
 
 
 def cluster_status(runtime) -> dict[str, Any] | None:
@@ -139,4 +158,37 @@ def cluster_status(runtime) -> dict[str, Any] | None:
     )
     if aud is not None:
         out["audit"] = aud
+    # replica-served retrieval rollup: per route, the worst peer lag across
+    # doors and the pod-wide local-answer / fallback / gap / resync totals —
+    # one look answers "is every door actually serving locally, and how stale"
+    merged_ri: dict[str, dict] = {}
+    for p in processes.values():
+        for route, ent in (p.get("replica_index") or {}).items():
+            agg = merged_ri.setdefault(
+                route,
+                {
+                    "doors": 0,
+                    "rows_min": None,
+                    "lag_max_s": None,
+                    "unsynced": 0,
+                    "local": 0,
+                    "fallbacks": 0,
+                    "gaps": 0,
+                    "resyncs": 0,
+                },
+            )
+            agg["doors"] += 1
+            rows = ent.get("rows") or 0
+            agg["rows_min"] = (
+                rows if agg["rows_min"] is None else min(agg["rows_min"], rows)
+            )
+            lag = ent.get("lag_s")
+            if lag is None:
+                agg["unsynced"] += 1
+            elif agg["lag_max_s"] is None or lag > agg["lag_max_s"]:
+                agg["lag_max_s"] = lag
+            for k in ("local", "fallbacks", "gaps", "resyncs"):
+                agg[k] += ent.get(k) or 0
+    if merged_ri:
+        out["replica_index"] = merged_ri
     return out

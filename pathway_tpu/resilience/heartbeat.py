@@ -215,6 +215,19 @@ class HeartbeatMonitor:
                     out[pid] = serving
             return out
 
+    def peer_replica_index(self) -> dict[int, dict]:
+        """pid → the replica-index health block (route → rows/lag/local/
+        fallbacks/gaps/resyncs) piggybacked on that peer's heartbeats.
+        Retired peers are gone from ``_peers``, so a drained door's stale
+        lag never alarms the coordinator's rollup."""
+        with self._lock:
+            out = {}
+            for pid, st in self._peers.items():
+                ri = (st.summary or {}).get("replica_index")
+                if ri:
+                    out[pid] = ri
+            return out
+
     def peer_flow(self) -> dict[int, dict]:
         """pid → the flow-plane credit/occupancy block piggybacked on that
         peer's heartbeats ({} until one arrives). The coordinator merges these

@@ -126,19 +126,9 @@ class DocumentStore:
     def merge_filters(queries: Table) -> Table:
         """Combine metadata_filter and filepath_globpattern into one filter
         string (reference ``document_store.py`` merge_filters)."""
-
-        def combine(metadata_filter, globpattern):
-            parts = []
-            if metadata_filter:
-                parts.append(f"({metadata_filter})")
-            if globpattern:
-                escaped = str(globpattern).replace("\\", "\\\\").replace("'", "\\'")
-                parts.append(f"globmatch('{escaped}', path)")
-            return " && ".join(parts) if parts else None
-
         return queries.with_columns(
             metadata_filter=pw.apply_with_type(
-                combine,
+                combine_filters,
                 dt.Optional(dt.STR),
                 pw.this.metadata_filter,
                 pw.this.filepath_globpattern,
@@ -229,6 +219,19 @@ class SlidesDocumentStore(DocumentStore):
     """Reference ``document_store.py:472`` variant exposing parsed slides; the
     gated SlideParser is unavailable in this image, so this is DocumentStore with
     the same extended query surface."""
+
+
+def combine_filters(metadata_filter: Any, globpattern: Any) -> str | None:
+    """One query's merged filter string — module-level (not a closure) so the
+    replica-served retrieval path (``fabric/index_replica.py``) merges filters
+    with definitionally the same bytes as the engine path."""
+    parts = []
+    if metadata_filter:
+        parts.append(f"({metadata_filter})")
+    if globpattern:
+        escaped = str(globpattern).replace("\\", "\\\\").replace("'", "\\'")
+        parts.append(f"globmatch('{escaped}', path)")
+    return " && ".join(parts) if parts else None
 
 
 def _as_dict(md: Any) -> dict:

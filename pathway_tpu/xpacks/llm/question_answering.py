@@ -171,7 +171,12 @@ class BaseRAGQuestionAnswerer:
     # -- REST serving -------------------------------------------------------
     def build_server(self, host: str, port: int, **kwargs) -> None:
         """Register /v2/answer, /v2/summarize, /v2/list_documents,
-        /v2/statistics, /v1/retrieve endpoints (reference ``:314`` region)."""
+        /v2/statistics, /v1/retrieve endpoints (reference ``:314`` region).
+
+        On cluster runs with the fabric on, ``/v1/retrieve`` is replica-served:
+        QARestServer inherits DocumentStoreServer's arming, so every fabric
+        door answers retrieval from its changelog-fed local index within
+        ``PATHWAY_REPLICA_MAX_STALENESS_MS`` (``fabric/index_replica.py``)."""
         from pathway_tpu.xpacks.llm.servers import QARestServer
 
         self.server = QARestServer(host, port, self, **kwargs)
@@ -267,10 +272,18 @@ class RAGClient:
             payload["filters"] = filters
         return self._post("/v2/answer", payload)
 
-    def retrieve(self, query: str, k: int = 3, metadata_filter: str | None = None):
+    def retrieve(
+        self,
+        query: str,
+        k: int = 3,
+        metadata_filter: str | None = None,
+        filepath_globpattern: str | None = None,
+    ):
         payload = {"query": query, "k": k}
         if metadata_filter is not None:
             payload["metadata_filter"] = metadata_filter
+        if filepath_globpattern is not None:
+            payload["filepath_globpattern"] = filepath_globpattern
         return self._post("/v1/retrieve", payload)
 
     def statistics(self):

@@ -26,8 +26,16 @@ class BaseRestServer:
         self.webserver = PathwayWebserver(host=host, port=port)
 
     def serve(
-        self, route: str, schema, handler: Callable, documentation=None, **kwargs
+        self,
+        route: str,
+        schema,
+        handler: Callable,
+        documentation=None,
+        replica_route=None,
+        **kwargs,
     ) -> None:
+        from pathway_tpu.fabric import index_replica as _index_replica
+
         queries, writer = rest_connector(
             webserver=self.webserver,
             route=route,
@@ -37,7 +45,16 @@ class BaseRestServer:
             or EndpointDocumentation(summary=f"{type(self).__name__} {route}"),
             **kwargs,
         )
-        writer(handler(queries))
+        # replica-served retrieval: defining the handler's dataflow under
+        # capturing(...) lets DataIndex wire the index changelog feed; every
+        # door then answers this route from a local replica index within the
+        # staleness bound (fabric/index_replica.py)
+        with _index_replica.capturing(replica_route):
+            writer(handler(queries))
+        if replica_route is not None:
+            for r, _methods, _h, meta in self.webserver._routes:
+                if r == route and meta is not None:
+                    replica_route.state = meta.get("serving")
 
     def run(self, threaded: bool = False, with_cache: bool = False, **kwargs):
         """Build & run the dataflow (blocks; threaded=True runs in a thread)."""
@@ -52,12 +69,21 @@ class DocumentStoreServer(BaseRestServer):
     """Reference ``servers.py:92``: retrieve/statistics/inputs endpoints."""
 
     def __init__(self, host: str, port: int, document_store, **kwargs):
+        from pathway_tpu.fabric import index_replica as _index_replica
+
         super().__init__(host, port, **kwargs)
         self.document_store = document_store
+        # cluster runs with the fabric + replica plane on: every door serves
+        # /v1/retrieve from a changelog-fed local replica index (held here —
+        # the registry is weak; None on single-process / fabric-off runs)
+        self.replica_route = _index_replica.maybe_arm(
+            "/v1/retrieve", document_store
+        )
         self.serve(
             "/v1/retrieve",
             document_store.RetrieveQuerySchema,
             document_store.retrieve_query,
+            replica_route=self.replica_route,
         )
         self.serve(
             "/v1/statistics",

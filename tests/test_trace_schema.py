@@ -246,3 +246,65 @@ def test_request_plane_span_record_shapes():
     decomp = doc["decomposition_ms"]
     assert decomp["index/search"] == pytest.approx(0.002)
     assert decomp["serve/respond"] == pytest.approx(0.001)
+
+
+def test_replica_span_record_shapes():
+    """Unit (r20): a replica-served retrieval's spans — the door's local
+    embed + search boundaries recorded via note_boundary — validate and
+    decompose exactly like owner-side engine stages."""
+    from pathway_tpu.internals.config import get_pathway_config
+
+    plane = req_mod.RequestTracePlane(get_pathway_config())
+    plane.slow_ms = 0.0  # keep unconditionally
+    now = time.time_ns()
+    key = 8888
+    plane.begin(key, "/v1/retrieve", now)
+    plane.note_boundary(key, "replica/embed", now, now + 4_000, None)
+    plane.note_boundary(key, "replica/search", now + 4_000, now + 9_000, {"rows": 3})
+    doc = plane.complete(key, "ok", now + 10_000, now + 11_000)
+    assert doc is not None
+    for span in doc["spans"]:
+        validate_span(span)
+    names = [s["name"] for s in doc["spans"]]
+    assert names[0] == "request"
+    assert "replica/embed" in names and "replica/search" in names
+    search = next(s for s in doc["spans"] if s["name"] == "replica/search")
+    attrs = {a["key"]: a["value"] for a in search["attributes"]}
+    assert attrs["rows"] == {"intValue": "3"}
+    decomp = doc["decomposition_ms"]
+    assert decomp["replica/embed"] == pytest.approx(0.004)
+    assert decomp["replica/search"] == pytest.approx(0.005)
+
+
+def test_embedder_memo_metric_line_shapes():
+    """Unit (r20): the shared-memo Prometheus series are well-formed
+    exposition text — HELP/TYPE per series, escaped embedder labels, and a
+    hit ratio that agrees with the counters."""
+    import re
+
+    from pathway_tpu.xpacks.llm import embedders as emb_mod
+
+    emb = emb_mod.SentenceTransformerEmbedder("tiny", seed=777, memoize=8)
+    emb.func(["trace schema memo q1", "trace schema memo q2"])
+    emb.func(["trace schema memo q1"])  # one hit
+    lines = emb_mod.memo_prometheus_lines()
+    sample = re.compile(
+        r"^pathway_embedder_memo_[a-z_]+\{embedder=\"[^\"]+\"\} "
+        r"-?\d+(\.\d+)?$"
+    )
+    for line in lines:
+        assert line.startswith("#") or sample.match(line), line
+    series = {
+        line.split()[2] for line in lines if line.startswith("# TYPE")
+    }
+    assert {
+        "pathway_embedder_memo_hits_total",
+        "pathway_embedder_memo_misses_total",
+        "pathway_embedder_memo_evictions_total",
+        "pathway_embedder_memo_entries",
+        "pathway_embedder_memo_hit_ratio",
+    } <= series
+    label = f'embedder="{emb.memo_fingerprint}"'
+    body = "\n".join(lines)
+    assert f"pathway_embedder_memo_hits_total{{{label}}} 1" in body
+    assert f"pathway_embedder_memo_misses_total{{{label}}} 2" in body
