@@ -616,6 +616,139 @@ def request_trace_leg(docs: list[str], rng: np.random.Generator) -> dict:
     }
 
 
+# ------------------------------------------------- leg 4b: health-plane cost
+
+HEALTH_OVERHEAD_PCT = 5.0  # default-on budget for the r21 health plane
+
+
+def health_leg(docs: list[str], rng: np.random.Generator) -> dict:
+    """Default-on overhead of the pod health & SLO plane (r21): the SAME
+    coalesced serving work driven with ``PATHWAY_HEALTH`` on vs off,
+    interleaved per rep with the mode ORDER rotated (r10 discipline), best-of
+    per mode. The on-mode runs the full plane — door state machine, the
+    500 ms SLO evaluator sampling the serving counters, AND canary probes
+    pinned to a 100 ms cadence (the 1 s default would never fire inside these
+    sub-second sessions; faster probing is strictly MORE on-mode work, so the
+    delta is an upper bound on what a production pod pays). Canary exclusion
+    is asserted inside the leg: the user-facing request counter must equal
+    exactly the requests the clients sent, probes notwithstanding."""
+    from pathway_tpu.observability import health as health_mod
+
+    os.environ["PATHWAY_CANARY_INTERVAL_MS"] = "100"
+    total = TRACE_CLIENTS * TRACE_REQS_PER_CLIENT
+
+    def fresh(tag: str) -> list[list[str]]:
+        qs = [
+            f"{docs[int(i)]} {tag}q{j}"
+            for j, i in enumerate(rng.integers(0, len(docs), total))
+        ]
+        return [
+            qs[ci * TRACE_REQS_PER_CLIENT : (ci + 1) * TRACE_REQS_PER_CLIENT]
+            for ci in range(TRACE_CLIENTS)
+        ]
+
+    # untimed warm session with the plane ON: the evaluator/canary thread's
+    # first samples, serving-path imports and padded-bucket XLA compiles all
+    # land outside both measured modes
+    os.environ["PATHWAY_HEALTH"] = "on"
+    serve_session(
+        docs,
+        _concurrent_client(fresh("hwarm")),
+        tick_mode="arrival",
+        autocommit_ms=TPUT_AUTOCOMMIT_MS,
+    )
+
+    # per-session totals: each _concurrent_client client sends 2 untimed
+    # warm requests before the measured batch
+    expected_requests = total + TRACE_CLIENTS * 2
+
+    def observed_client(per_client: list[list[str]], sink: dict):
+        # capture the plane's canary counters INSIDE the session (the plane
+        # is torn down when the run ends)
+        inner = _concurrent_client(per_client)
+
+        def client(port: int):
+            res = inner(port)
+            plane = health_mod.current()
+            if plane is not None:
+                sink["canary"] = plane.canary_snapshot()
+            return res
+
+        return client
+
+    qps = {"on": [], "off": []}
+    answers: dict[str, dict] = {}
+    canary_probes = 0
+    canary_failed = 0
+    canary_excluded = True
+    for rep in range(TRACE_REPS):
+        per_client = fresh(f"h{rep}")
+        order = ("on", "off") if rep % 2 == 0 else ("off", "on")
+        for mode in order:
+            os.environ["PATHWAY_HEALTH"] = mode
+            sink: dict = {}
+            (wall, ans), route, _fl = serve_session(
+                docs,
+                observed_client(per_client, sink),
+                tick_mode="arrival",
+                autocommit_ms=TPUT_AUTOCOMMIT_MS,
+            )
+            qps[mode].append(total / wall)
+            if rep == 0:
+                answers[mode] = ans
+            if mode == "on":
+                for ent in (sink.get("canary") or {}).values():
+                    canary_probes += ent["requests"]
+                    canary_failed += ent["failed"]
+                # canaries must NEVER count as user traffic: the route's
+                # request counter is exactly the client-driven total
+                if route.get("requests_total") != expected_requests:
+                    canary_excluded = False
+    os.environ.pop("PATHWAY_HEALTH", None)
+    os.environ.pop("PATHWAY_CANARY_INTERVAL_MS", None)
+    qps_on, qps_off = max(qps["on"]), max(qps["off"])
+    spread = max(max(v) / max(min(v), 1e-9) for v in qps.values())
+    overhead_qps_pct = round(100.0 * (1.0 - qps_on / qps_off), 2)
+    return {
+        "qps_on": round(qps_on, 1),
+        "qps_off": round(qps_off, 1),
+        "overhead_qps_pct": overhead_qps_pct,
+        "budget_pct": HEALTH_OVERHEAD_PCT,
+        "rep_spread": round(spread, 2),
+        "byte_identical": answers.get("on") == answers.get("off"),
+        "canary_probes_on": canary_probes,
+        "canary_failed_on": canary_failed,
+        "canary_excluded_from_user_counters": canary_excluded,
+        "within_budget": bool(overhead_qps_pct <= HEALTH_OVERHEAD_PCT),
+    }
+
+
+def health_gates(hl: dict) -> tuple[bool, list[str], list[str]]:
+    """(ok, failures, warnings) for the health leg: byte identity and canary
+    exclusion are host-independent hard gates; the ≤5% overhead gate
+    downgrades on detectably-noisy hosts (spread > 1.6, the r16 precedent)."""
+    failures: list[str] = []
+    warnings: list[str] = []
+    ok = True
+    if not hl["byte_identical"]:
+        ok = False
+        failures.append("health plane on vs off answers not byte-identical")
+    if not hl["canary_excluded_from_user_counters"]:
+        ok = False
+        failures.append("canary probes leaked into user-facing request counters")
+    if not hl["within_budget"]:
+        msg = (
+            f"health default-on overhead past {HEALTH_OVERHEAD_PCT}%: "
+            f"qps {hl['overhead_qps_pct']}%"
+        )
+        if hl["rep_spread"] > 1.6:
+            warnings.append(f"{msg} — downgraded: noisy host (spread {hl['rep_spread']})")
+        else:
+            ok = False
+            failures.append(msg)
+    return ok, failures, warnings
+
+
 # --------------------------------------------------- leg 5: fabric multi-door
 
 FABRIC_PROCS = 3
@@ -1560,6 +1693,8 @@ def full(n_docs: int = N_DOCS, out_path: str | None = None) -> dict:
             "PATHWAY_FLOW_BULK_MAX_ROWS",
             "PATHWAY_INPUT_QUEUE_ROWS",
             "PATHWAY_REQUEST_TRACE",
+            "PATHWAY_HEALTH",
+            "PATHWAY_CANARY_INTERVAL_MS",
         )
     }
     try:
@@ -1575,6 +1710,7 @@ def full(n_docs: int = N_DOCS, out_path: str | None = None) -> dict:
         tput = throughput_leg(docs, rng)
         flood = flood_leg(docs, rng)
         rtrace = request_trace_leg(docs, rng)
+        hl = health_leg(docs, rng)
         fab = fabric_leg()
         zh = zerohop_leg()
         rep = replica_leg()
@@ -1589,6 +1725,7 @@ def full(n_docs: int = N_DOCS, out_path: str | None = None) -> dict:
                 "throughput": tput,
                 "flood": flood,
                 "request_trace": rtrace,
+                "health": hl,
                 "fabric": fab,
                 "zero_hop": zh,
                 "replica_read": rep,
@@ -1599,6 +1736,7 @@ def full(n_docs: int = N_DOCS, out_path: str | None = None) -> dict:
             "fabric_qps_scaling": fab["fabric_qps_scaling"],
             "zero_hop_speedup": zh["zero_hop_speedup"],
             "replica_read_qps_scaling": rep["replica_read_qps_scaling"],
+            "health_overhead_qps_pct": hl["overhead_qps_pct"],
         }
         spread = tput["rep_spread"]
         noisy = spread > 1.6
@@ -1636,7 +1774,8 @@ def full(n_docs: int = N_DOCS, out_path: str | None = None) -> dict:
         fab_ok, fab_failures, fab_warnings = fabric_gates(fab, out_path)
         zh_ok, zh_failures, zh_warnings = zerohop_gates(zh, out_path)
         rep_ok, rep_failures, rep_warnings = replica_gates(rep, out_path)
-        for w in fab_warnings + zh_warnings + rep_warnings:
+        hl_ok, hl_failures, hl_warnings = health_gates(hl)
+        for w in fab_warnings + zh_warnings + rep_warnings + hl_warnings:
             print(f"WARNING: {w}", file=sys.stderr)
         if not fab_ok:
             gate_ok = False
@@ -1647,6 +1786,9 @@ def full(n_docs: int = N_DOCS, out_path: str | None = None) -> dict:
         if not rep_ok:
             gate_ok = False
             failures.extend(rep_failures)
+        if not hl_ok:
+            gate_ok = False
+            failures.extend(hl_failures)
         if not rtrace["within_budget"]:
             msg = (
                 f"request-trace default-on overhead past {TRACE_OVERHEAD_PCT}%: "
@@ -1754,6 +1896,59 @@ def replica_only(out_path: str | None = None) -> dict:
     return results
 
 
+def health_only(n_docs: int = N_DOCS, out_path: str | None = None) -> dict:
+    """Just the r21 health-plane leg: emits a BENCH json carrying
+    ``health_overhead_qps_pct`` (with the ≤5% default-on gate, byte-identity
+    and canary-exclusion checks) without re-running the other serving legs."""
+    prev_env = {
+        k: os.environ.get(k)
+        for k in (
+            "PATHWAY_SERVE_TICK",
+            "PATHWAY_SERVE_COALESCE_MS",
+            "PATHWAY_FLOW",
+            "PATHWAY_MICROBATCH",
+            "PATHWAY_MICROBATCH_FLUSH_MS",
+            "PATHWAY_FLOW_BULK_MIN_ROWS",
+            "PATHWAY_FLOW_BULK_MAX_ROWS",
+            "PATHWAY_INPUT_QUEUE_ROWS",
+            "PATHWAY_HEALTH",
+            "PATHWAY_CANARY_INTERVAL_MS",
+        )
+    }
+    try:
+        docs = synth_docs(n_docs)
+        rng = np.random.default_rng(23)
+        emb, _ = _models()
+        for b in (8, 16, 32, 64, 128, 256, 512):
+            emb._encoder.encode_texts((docs * 2)[:b])
+        hl = health_leg(docs, rng)
+    finally:
+        for k, v in prev_env.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+    results: dict = {
+        "bench": "serving_health",
+        "n_docs": n_docs,
+        "preset": PRESET,
+        "serving": {"health": hl},
+        "health_overhead_qps_pct": hl["overhead_qps_pct"],
+    }
+    ok, failures, warnings = health_gates(hl)
+    for w in warnings:
+        print(f"WARNING: {w}", file=sys.stderr)
+    results["gate_ok"] = ok
+    if not ok:
+        print(json.dumps(results))
+        for f in failures:
+            print(f"GATE FAILURE: {f}", file=sys.stderr)
+        if os.environ.get("BENCH_MODE") == "1":
+            sys.exit(1)
+        print("WARNING: gate failures above (hard-fail under BENCH_MODE=1)", file=sys.stderr)
+    return results
+
+
 if __name__ == "__main__":
     args = sys.argv[1:]
     out_path = None
@@ -1772,6 +1967,9 @@ if __name__ == "__main__":
     elif "--replica-only" in args:
         args.remove("--replica-only")
         res = replica_only(out_path=out_path)
+    elif "--health" in args:
+        args.remove("--health")
+        res = health_only(n, out_path=out_path)
     else:
         res = full(n, out_path=out_path)
     line = json.dumps(res)

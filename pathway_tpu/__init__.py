@@ -136,6 +136,18 @@ def set_license_key(key: str | None) -> None:
     pass  # no license enforcement in the TPU build (reference: src/engine/license.rs)
 
 
+def set_slo(route: str | None = None, *, p99_ms: float | None = None,
+            availability: float | None = None) -> None:
+    """Declare a serving SLO for the health plane (``PATHWAY_HEALTH``):
+    ``p99_ms`` bounds a route's p99 latency (route=None applies to all
+    routes), ``availability`` sets the pod-wide success-ratio target. The
+    burn-rate evaluator (``observability/health.py``) alerts when the error
+    budget burns faster than the fast AND slow window thresholds."""
+    from pathway_tpu.observability.health import set_slo as _set_slo
+
+    _set_slo(route, p99_ms=p99_ms, availability=availability)
+
+
 def set_monitoring_config(*, server_endpoint: str | None = None, **kwargs) -> None:
     """Configure trace export. ``trace_file=...`` writes an OTLP/JSON trace
     document per run (``internals/telemetry.py``); pass ``trace_file=None``

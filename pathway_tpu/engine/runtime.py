@@ -175,6 +175,11 @@ class Runtime:
 
         for driver in self.connectors:
             driver.start()
+        # connectors are live and the graph is built: this door may now
+        # receive traffic (health plane: starting → ready)
+        from pathway_tpu.observability import health as _health
+
+        _health.mark_ready()
 
         if not self.connectors:
             # static mode: single batch tick
@@ -208,6 +213,9 @@ class Runtime:
                     if elapsed < period:
                         self.wakeup.wait(period - elapsed)
         finally:
+            # doors answer 503 + Retry-After from here on: drain before the
+            # connector stop flushes pending request futures
+            _health.mark_draining("shutdown")
             for driver in self.connectors:
                 driver.stop()
         # a subject may error and close between the failure check and the

@@ -266,6 +266,15 @@ class FabricPlane:
         from pathway_tpu.observability import requests as _req_trace
 
         async def handler(request: "web.Request") -> "web.Response":
+            from pathway_tpu.observability import health as _health
+
+            hp = _health.current()
+            if hp is not None and request.headers.get("X-Pathway-Canary"):
+                # synthetic self-probe: answered from the door state machine
+                # BEFORE counters, gauntlet or the forward hop — canaries must
+                # never show up as traffic or reach the owner's engine
+                status, doc = hp.canary_response(rs.route)
+                return web.json_response(doc, status=status)
             rs.requests_total += 1
             gated = S.gate_check(rs, request.headers)
             if gated is not None:
@@ -415,6 +424,14 @@ class FabricPlane:
         from pathway_tpu.observability import requests as _req_trace
 
         async def handler(request: "web.Request") -> "web.Response":
+            from pathway_tpu.observability import health as _health
+
+            hp = _health.current()
+            if hp is not None and request.headers.get("X-Pathway-Canary"):
+                # synthetic self-probe: state-machine answer only, no engine
+                # or replica work, no user-facing counters
+                status, doc = hp.canary_response(rs.route)
+                return web.json_response(doc, status=status)
             rs.requests_total += 1
             gated = S.gate_check(rs, request.headers)
             if gated is not None:
@@ -928,6 +945,11 @@ class FabricPlane:
         if token in self._resyncing:
             return
         self._resyncing.add(token)
+        # readiness: this door serves the route from a replica that just
+        # gapped — demote it to syncing until the snapshot lands
+        from pathway_tpu.observability import health as _health
+
+        _health.door_syncing(token)
 
         def pull() -> None:
             try:
@@ -953,6 +975,7 @@ class FabricPlane:
                 pass  # stays stale; lookups keep falling back to the owner
             finally:
                 self._resyncing.discard(token)
+                _health.door_synced(token)
 
         if wait:
             pull()
@@ -982,6 +1005,9 @@ class FabricPlane:
         if token in self._resyncing:
             return
         self._resyncing.add(token)
+        from pathway_tpu.observability import health as _health
+
+        _health.door_syncing(token)
 
         def pull() -> None:
             try:
@@ -1008,6 +1034,7 @@ class FabricPlane:
                 pass  # stays unsynced; the route keeps forwarding
             finally:
                 self._resyncing.discard(token)
+                _health.door_synced(token)
 
         if wait:
             pull()

@@ -859,6 +859,149 @@ class PathwayConfig:
         quarter-sized ring of their own)."""
         return max(64, _env_int("PATHWAY_FLIGHT_EVENTS", 1024))
 
+    # ---- pod health & SLO plane (observability) -----------------------------
+    @property
+    def health(self) -> str:
+        """Pod health & SLO plane (``observability/health.py``): ``on``
+        (default) runs the per-door readiness state machine
+        (``/healthz``/``/readyz`` on every door), synthetic canary probes,
+        declared-SLO burn-rate evaluation, rule-based detectors and the alert
+        registry with incident bundles. ``off`` installs nothing — the
+        serving path is byte-identical to the plane never existing."""
+        raw = os.environ.get("PATHWAY_HEALTH", "on").strip().lower()
+        if raw in ("", "1", "true", "yes", "on"):
+            return "on"
+        if raw in ("0", "false", "no", "off"):
+            return "off"
+        raise ValueError(f"PATHWAY_HEALTH must be off/on, got {raw!r}")
+
+    @property
+    def health_eval_ms(self) -> int:
+        """Interval between SLO/detector evaluator sweeps (burn-rate windows,
+        watermark-stall/replica-lag/error-rate/backlog/thrash rules)."""
+        return max(50, _env_int("PATHWAY_HEALTH_EVAL_MS", 500))
+
+    @property
+    def slo_availability(self) -> float:
+        """Pod-wide availability objective in (0, 1): the success-rate target
+        the burn-rate rule guards (successes = served responses + passing
+        canaries; failures = timeouts + failing canaries). Overridable live
+        via ``pw.set_slo(availability=…)``."""
+        v = _env_float("PATHWAY_SLO_AVAILABILITY", 0.999)
+        if not 0.0 < v < 1.0:
+            raise ValueError(
+                f"PATHWAY_SLO_AVAILABILITY must be in (0, 1), got {v}"
+            )
+        return v
+
+    @property
+    def slo_p99_ms(self) -> float:
+        """Default per-route latency objective: 99% of requests under this
+        many milliseconds. 0 (default) declares no latency SLO unless
+        ``pw.set_slo(route=…, p99_ms=…)`` does."""
+        v = _env_float("PATHWAY_SLO_P99_MS", 0.0)
+        if v < 0:
+            raise ValueError(f"PATHWAY_SLO_P99_MS must be >= 0, got {v}")
+        return v
+
+    @property
+    def slo_fast_window_s(self) -> float:
+        """Fast burn-rate window (seconds) — catches sudden total breaches."""
+        return max(1.0, _env_float("PATHWAY_SLO_FAST_WINDOW_S", 60.0))
+
+    @property
+    def slo_slow_window_s(self) -> float:
+        """Slow burn-rate window (seconds) — confirms the breach is sustained
+        (multi-window rule: an alert needs BOTH windows burning)."""
+        return max(1.0, _env_float("PATHWAY_SLO_SLOW_WINDOW_S", 600.0))
+
+    @property
+    def slo_burn_fast(self) -> float:
+        """Burn-rate threshold for the fast window (1.0 = exactly spending
+        the error budget; 14 ≈ the SRE Workbook's page-severity rate)."""
+        return max(0.0, _env_float("PATHWAY_SLO_BURN_FAST", 14.0))
+
+    @property
+    def slo_burn_slow(self) -> float:
+        """Burn-rate threshold for the slow window."""
+        return max(0.0, _env_float("PATHWAY_SLO_BURN_SLOW", 2.0))
+
+    @property
+    def canary_interval_ms(self) -> int:
+        """Synthetic canary probe interval per door route (0 disables
+        canaries; readiness and detectors stay live)."""
+        return max(0, _env_int("PATHWAY_CANARY_INTERVAL_MS", 1000))
+
+    @property
+    def canary_timeout_ms(self) -> int:
+        """Timeout for one canary probe; a slower door counts as a failed
+        canary in the availability SLO."""
+        return max(50, _env_int("PATHWAY_CANARY_TIMEOUT_MS", 2000))
+
+    @property
+    def incident_dir(self) -> str | None:
+        """Incident-bundle directory: each alert activation captures one
+        correlated post-mortem JSON (alert, probable-cause stage, per-stage
+        p99 decomposition, slowest kept request traces, flight-recorder
+        rings, shard-map/membership versions, replica health). Unset = no
+        bundles (alerts still fire)."""
+        return os.environ.get("PATHWAY_INCIDENT_DIR") or None
+
+    @property
+    def alert_webhook(self) -> str | None:
+        """Generic webhook notification target: fired alerts POST one JSON
+        document each, deduped on (alert, fingerprint) with bounded
+        retry/backoff."""
+        return os.environ.get("PATHWAY_ALERT_WEBHOOK") or None
+
+    @property
+    def alert_slack_channel(self) -> str | None:
+        """Slack channel id for alert notifications (needs
+        ``PATHWAY_ALERT_SLACK_TOKEN``); same delivery discipline as the
+        webhook sink, posting through ``pw.io.slack``'s chat.postMessage."""
+        return os.environ.get("PATHWAY_ALERT_SLACK_CHANNEL") or None
+
+    @property
+    def alert_slack_token(self) -> str | None:
+        """Slack bot token for the alert notification sink."""
+        return os.environ.get("PATHWAY_ALERT_SLACK_TOKEN") or None
+
+    @property
+    def alert_watermark_stall_s(self) -> float:
+        """Watermark-stall detector: an input whose watermark lags this many
+        seconds (after ingesting rows) raises ``watermark_stall``."""
+        return max(1.0, _env_float("PATHWAY_ALERT_WATERMARK_STALL_S", 120.0))
+
+    @property
+    def alert_error_rate(self) -> float:
+        """Error-rate-spike detector: fraction of a route's requests failing
+        (4xx/timeouts) over the fast window that raises
+        ``error_rate_spike``."""
+        v = _env_float("PATHWAY_ALERT_ERROR_RATE", 0.10)
+        if not 0.0 < v <= 1.0:
+            raise ValueError(
+                f"PATHWAY_ALERT_ERROR_RATE must be in (0, 1], got {v}"
+            )
+        return v
+
+    @property
+    def alert_backlog_rows(self) -> int:
+        """Backlog-growth detector: queued rows past this bound AND rising
+        raise ``backlog_growth``."""
+        return max(1, _env_int("PATHWAY_ALERT_BACKLOG_ROWS", 100000))
+
+    @property
+    def alert_thrash_decisions(self) -> int:
+        """Autoscaler-thrash detector: membership version changes within the
+        slow window that raise ``autoscaler_thrash``."""
+        return max(1, _env_int("PATHWAY_ALERT_THRASH_DECISIONS", 3))
+
+    @property
+    def alert_heartbeat_flaps(self) -> int:
+        """Heartbeat-flap detector: heartbeat misses accumulating within the
+        fast window that raise ``heartbeat_flap``."""
+        return max(1, _env_int("PATHWAY_ALERT_HEARTBEAT_FLAPS", 3))
+
     # ---- helpers ------------------------------------------------------------
     @property
     def total_workers(self) -> int:
@@ -932,6 +1075,25 @@ class PathwayConfig:
                 "request_trace_keep",
                 "request_trace_kept",
                 "flight_dir",
+                "health",
+                "health_eval_ms",
+                "slo_availability",
+                "slo_p99_ms",
+                "slo_fast_window_s",
+                "slo_slow_window_s",
+                "slo_burn_fast",
+                "slo_burn_slow",
+                "canary_interval_ms",
+                "canary_timeout_ms",
+                "incident_dir",
+                "alert_webhook",
+                "alert_slack_channel",
+                "alert_slack_token",
+                "alert_watermark_stall_s",
+                "alert_error_rate",
+                "alert_backlog_rows",
+                "alert_thrash_decisions",
+                "alert_heartbeat_flaps",
                 "run_id",
                 "engine_phases",
                 "device_exchange_fused",

@@ -190,6 +190,13 @@ def run_stats(runtime) -> dict[str, Any]:
     fabric = _fabric.status(runtime)
     if fabric is not None:
         stats["fabric"] = fabric
+    # pod health & SLO plane (PATHWAY_HEALTH): door state machine, canary
+    # probes, burn rates and the active-alert set
+    from pathway_tpu.observability import health as _health
+
+    health = _health.status(runtime)
+    if health is not None:
+        stats["health"] = health
     # embedding memo counters (exact hits/misses/evictions + the pod-wide
     # shared tier) — sys.modules gate: no xpacks import unless the pipeline
     # already made one
@@ -350,6 +357,10 @@ def prometheus_text(runtime) -> str:
     from pathway_tpu import fabric as _fabric
 
     lines.extend(_fabric.prometheus_lines(runtime))
+    # ---- pod health & SLO plane (door state, canaries, burn rates, alerts) --
+    from pathway_tpu.observability import health as _health
+
+    lines.extend(_health.prometheus_lines(runtime))
     # ---- embedding memo (hit ratio + shared tier) ---------------------------
     import sys as _sys
 
@@ -485,6 +496,22 @@ def _scale_payload(runtime, query: str) -> bytes:
     return json.dumps(doc).encode()
 
 
+def _alerts_payload() -> tuple[int, dict, dict[str, str]]:
+    """``/alerts``: the structured active-alert set, recent resolutions,
+    per-alert fired counters and sink delivery counters."""
+    from pathway_tpu.observability import alerts as _alerts
+
+    registry = _alerts.current()
+    if registry is None:
+        return (
+            200,
+            {"ok": False, "error": "health plane is off (PATHWAY_HEALTH=off)"},
+            {},
+        )
+    doc = {"ok": True, **registry.status_summary()}
+    return 200, doc, {}
+
+
 def _request_payload(query: str) -> bytes:
     """``/request?id=<request_id>``: one request's kept flight-path trace
     (OTLP spans + per-stage latency decomposition), or its in-flight status.
@@ -538,7 +565,48 @@ class MonitoringHttpServer:
                 pass
 
             def do_GET(self):
+                from pathway_tpu.observability import health as _health
+
                 path, _, query = self.path.partition("?")
+                if path.rstrip("/") in ("/healthz", "/readyz", "/alerts"):
+                    # door endpoints: served even while draining — liveness
+                    # and the active-alert set are exactly what an operator
+                    # needs when the pod is quiescing
+                    if path.rstrip("/") == "/healthz":
+                        status, doc = _health.healthz_payload()
+                        hdrs = {}
+                    elif path.rstrip("/") == "/readyz":
+                        status, doc, hdrs = _health.readyz_payload()
+                    else:
+                        status, doc, hdrs = _alerts_payload()
+                    body = json.dumps(doc, default=str).encode()
+                    self.send_response(status)
+                    self.send_header("Content-Type", "application/json")
+                    self.send_header("Content-Length", str(len(body)))
+                    for k, v in hdrs.items():
+                        self.send_header(k, v)
+                    self.end_headers()
+                    self.wfile.write(body)
+                    return
+                if path.rstrip("/") in ("/metrics", "/status") and _health.quiescing():
+                    # monitoring consistent with readiness: while the pod
+                    # quiesces to a rescale epoch, half-merged numbers would
+                    # mislead a scraper — answer 503 like the doors do
+                    plane = _health.current()
+                    body = json.dumps(
+                        {
+                            "ok": False,
+                            "state": "draining",
+                            "reason": plane.drain_reason() if plane else None,
+                        }
+                    ).encode()
+                    self.send_response(503)
+                    self.send_header("Content-Type", "application/json")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.send_header("Retry-After", "5")
+                    self.end_headers()
+                    self.wfile.write(body)
+                    return
                 if path.rstrip("/") == "/metrics":
                     body = prometheus_text(rt).encode()
                     ctype = "text/plain; version=0.0.4"

@@ -746,6 +746,28 @@ class PathwayWebserver:
 
         app.router.add_route("GET", "/_schema", schema_handler)
 
+        # every door serves liveness/readiness from the health plane's door
+        # state machine (unconditional 200s when the plane is off) — the
+        # contract a load balancer probes; user routes win a name collision
+        taken = {r for r, _m, _h, _meta in self._routes}
+
+        async def healthz_handler(_request: "web.Request") -> "web.Response":
+            from pathway_tpu.observability import health as _health
+
+            status, doc = _health.healthz_payload()
+            return web.json_response(doc, status=status)
+
+        async def readyz_handler(_request: "web.Request") -> "web.Response":
+            from pathway_tpu.observability import health as _health
+
+            status, doc, headers = _health.readyz_payload()
+            return web.json_response(doc, status=status, headers=headers or None)
+
+        if "/healthz" not in taken:
+            app.router.add_route("GET", "/healthz", healthz_handler)
+        if "/readyz" not in taken:
+            app.router.add_route("GET", "/readyz", readyz_handler)
+
         self._started.clear()
         self._start_error = None
 
@@ -930,6 +952,15 @@ def rest_connector(
         )
 
     async def handler(request: "web.Request") -> "web.Response":
+        from pathway_tpu.observability import health as _health
+
+        hp = _health.current()
+        if hp is not None and request.headers.get("X-Pathway-Canary"):
+            # synthetic self-probe: answer from the door state machine and
+            # return BEFORE any user-facing counter or engine work — canaries
+            # must never show up as traffic
+            status, doc = hp.canary_response(route)
+            return web.json_response(doc, status=status)
         state.requests_total += 1
         gated = gate_check(state, request.headers)
         if gated is not None:

@@ -1,9 +1,11 @@
 """Slack writer (reference: ``python/pathway/io/slack``): posts one message per
-positive output diff to a channel via chat.postMessage."""
+positive output diff to a channel via chat.postMessage. The shared
+:func:`post_message` helper is also the delivery path of the health plane's
+Slack notification sink (``observability/alerts.SlackSink``)."""
 
 from __future__ import annotations
 
-from typing import Any
+from typing import Any, Callable
 
 from pathway_tpu.engine import operators as ops
 from pathway_tpu.internals.logical import LogicalNode
@@ -11,23 +13,40 @@ from pathway_tpu.internals.table import Table
 from pathway_tpu.io._format import SingleColumnFormatter
 
 
-def send_alerts(alerts: Table, slack_channel_id: str, slack_token: str, **kwargs: Any) -> None:
+def post_message(
+    channel: str,
+    token: str,
+    text: str,
+    transport: Callable[[str, dict, dict], Any] | None = None,
+) -> None:
+    """One ``chat.postMessage`` call. ``transport(url, headers, json_body)``
+    is injectable so tests (and the alert sink's fake-transport test) never
+    touch the network."""
+    url = "https://slack.com/api/chat.postMessage"
+    headers = {"Authorization": f"Bearer {token}"}
+    body = {"channel": channel, "text": text}
+    if transport is not None:
+        transport(url, headers, body)
+        return
     import requests
 
+    requests.post(url, headers=headers, json=body)
+
+
+def send_alerts(alerts: Table, slack_channel_id: str, slack_token: str, **kwargs: Any) -> None:
     cols = alerts.column_names()
     fmt = SingleColumnFormatter(cols, cols[0])
+    transport = kwargs.get("_transport")
 
     def on_batch(batch, columns) -> None:
         for key, diff, row in batch.rows():
             if diff <= 0:
                 continue
-            requests.post(
-                "https://slack.com/api/chat.postMessage",
-                headers={"Authorization": f"Bearer {slack_token}"},
-                json={
-                    "channel": slack_channel_id,
-                    "text": fmt.format(int(key), row, batch.time, diff).decode(),
-                },
+            post_message(
+                slack_channel_id,
+                slack_token,
+                fmt.format(int(key), row, batch.time, diff).decode(),
+                transport=transport,
             )
 
     LogicalNode(

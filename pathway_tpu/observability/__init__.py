@@ -26,9 +26,11 @@ import secrets
 
 from pathway_tpu.observability import (
     aggregate,
+    alerts,
     audit,
     device,
     engine_phases,
+    health,
     lineage,
     metrics,
     requests,
@@ -91,6 +93,9 @@ def install_from_env(runtime=None) -> Tracer | None:
     # engine_bench — totals persist across runs until reset() so one bench
     # process can aggregate several pipelines
     engine_phases.install_from_env()
+    # pod health & SLO plane (door state machine, canaries, burn-rate alerts,
+    # incident bundles) — on by default; off installs nothing
+    health.install_from_env(runtime)
     if _tracer is not None:
         try:
             _tracer.close(emit_root=False)
@@ -119,6 +124,7 @@ def shutdown() -> None:
     """Close the live tracer (flush + root span + file sink). Never raises —
     runs in ``finally`` blocks next to connector/server teardown."""
     global _tracer
+    health.shutdown()
     device.shutdown()
     audit.shutdown()
     requests.shutdown()
@@ -138,12 +144,14 @@ __all__ = [
     "SpanBuffer",
     "Tracer",
     "aggregate",
+    "alerts",
     "audit",
     "backlog_gauges",
     "current",
     "derive_trace_id",
     "device",
     "engine_phases",
+    "health",
     "lineage",
     "input_watermarks",
     "install_from_env",

@@ -95,6 +95,14 @@ def local_summary(runtime) -> dict[str, Any]:
     )
     if replica_index is not None:
         summary["replica_index"] = replica_index
+    # health plane: this door's readiness state + active alerts ride the
+    # heartbeat so the coordinator sees which doors are syncing/draining and
+    # which alerts are firing anywhere in the pod
+    from pathway_tpu.observability import health as _health
+
+    hb = _health.heartbeat_summary()
+    if hb is not None:
+        summary["health"] = hb
     return summary
 
 
@@ -191,4 +199,27 @@ def cluster_status(runtime) -> dict[str, Any] | None:
                 agg[k] += ent.get(k) or 0
     if merged_ri:
         out["replica_index"] = merged_ri
+    # health rollup: per-door readiness states + the union of active alerts —
+    # "is the whole pod ready, and is anything firing" in one look
+    doors: dict[str, str] = {}
+    active_alerts: set[str] = set()
+    fired = 0
+    canary = {"requests": 0, "failed": 0}
+    for pid, p in processes.items():
+        h = p.get("health")
+        if not h:
+            continue
+        doors[pid] = h.get("state") or "unknown"
+        active_alerts.update(h.get("active") or ())
+        fired += h.get("fired") or 0
+        canary["requests"] += h.get("canary") or 0
+        canary["failed"] += h.get("canary_failed") or 0
+    if doors:
+        out["health"] = {
+            "doors": doors,
+            "all_ready": all(s == "ready" for s in doors.values()),
+            "active_alerts": sorted(active_alerts),
+            "alerts_fired": fired,
+            "canary": canary,
+        }
     return out

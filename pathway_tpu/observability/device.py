@@ -250,6 +250,7 @@ class DeviceStats:
             self.process_compile_s += own_s
         if bucket is not None and len(self.dispatch[label][2]) == self.shape_warn:
             flight_note("recompile_storm", callable=label, shapes=self.shape_warn)
+            _storm_alert(label, self.shape_warn)
 
     # -- padding / flops ------------------------------------------------------
     def note_pad_rows(self, label: str, real: int, pad: int) -> None:
@@ -394,6 +395,7 @@ class _TracedJit:
                         callable=self.label,
                         shapes=len(self._seen),
                     )
+                    _storm_alert(self.label, len(self._seen))
                 return out
             if st.want_split():
                 t0 = _time.perf_counter_ns()
@@ -538,6 +540,33 @@ def flight_note(kind: str, **attrs: Any) -> None:
     rec = _recorder
     if rec is not None and _stats.enabled:
         rec.note(kind, **attrs)
+
+
+def flight_snapshot() -> dict[str, list]:
+    """The flight-recorder rings (recent device events + ticks) — read by the
+    health plane's incident bundles and ``flight_dump``."""
+    return _recorder.snapshot()
+
+
+def _storm_alert(label: str, shapes: int) -> None:
+    """r10's recompile-storm tripwire unified into the alert registry: the
+    same condition that flags ``/status`` now fires through ``/alerts``,
+    Prometheus and the notification sinks like every other detector. No-op
+    when the health plane is off."""
+    from pathway_tpu.observability import alerts as _alerts
+
+    registry = _alerts.current()
+    if registry is not None:
+        registry.fire(
+            "recompile_storm",
+            fingerprint=label,
+            severity="warn",
+            summary=(
+                f"callable {label!r} compiled {shapes} distinct shapes — "
+                "bucketing is not closing the shape set"
+            ),
+            auto=False,
+        )
 
 
 def flight_dump(

@@ -1145,6 +1145,12 @@ class ClusterRuntime:
         fplane = _fabric.install_from_env(self)
         if fplane is not None:
             self.on_tick_done.append(fplane.on_tick_done)
+        # connectors live + fabric doors accepting: this door is ready
+        # (health plane: starting → ready; a replica resync will demote it
+        # to syncing until the gap closes)
+        from pathway_tpu.observability import health as _health
+
+        _health.mark_ready()
 
         period = (self.autocommit_duration_ms or 20) / 1000.0
         tick = 0
@@ -1204,8 +1210,15 @@ class ClusterRuntime:
                     plane.apply_cluster_signal(decision.get("flow"))
                 resc = decision.get("rescale")
                 if resc is not None:
+                    # readiness before the pause: every door flips to
+                    # draining (503 + Retry-After on /readyz) BEFORE the
+                    # quiesce drain tick, so a load balancer stops sending
+                    # traffic into the rescale window
                     self._rescale_decision = resc
+                    _health.mark_draining("rescale")
                 if decision["done"] or resc is not None:
+                    if decision["done"]:
+                        _health.mark_draining("shutdown")
                     self.run_tick(tick)  # drain final events
                     break
                 if self.pid == 0 and self.connectors and not all_virtual:

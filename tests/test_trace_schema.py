@@ -308,3 +308,131 @@ def test_embedder_memo_metric_line_shapes():
     body = "\n".join(lines)
     assert f"pathway_embedder_memo_hits_total{{{label}}} 1" in body
     assert f"pathway_embedder_memo_misses_total{{{label}}} 2" in body
+
+
+def test_health_alert_and_door_state_event_shapes(monkeypatch):
+    """Unit (r21): the health plane's trace events — ``health/door_state`` on
+    every lifecycle transition and ``alert/fired`` / ``alert/resolved`` from
+    the registry — are valid zero-duration spans with the documented attrs."""
+    from pathway_tpu.internals.config import get_pathway_config
+    from pathway_tpu.observability import alerts as alerts_mod
+    from pathway_tpu.observability import health as health_mod
+
+    monkeypatch.setenv("PATHWAY_TRACE", "on")
+    monkeypatch.setenv("PATHWAY_TRACE_SAMPLE", "1.0")
+    monkeypatch.setenv("PATHWAY_HEALTH", "off")  # plane driven by hand below
+    obs.install_from_env(None)
+    try:
+        tracer = obs.current()
+        assert tracer is not None
+        cfg = get_pathway_config()
+        plane = health_mod.HealthPlane(cfg)  # no thread: transitions by hand
+        plane.mark_ready()
+        plane.door_syncing(("ix", "/v1/retrieve", 0))
+        plane.door_synced(("ix", "/v1/retrieve", 0))
+        plane.mark_draining("rescale")
+        registry = alerts_mod.AlertRegistry(cfg)
+        registry.fire(
+            "slo_latency_burn",
+            fingerprint="/v1/retrieve",
+            severity="page",
+            summary="burn 16.7",
+        )
+        registry.resolve("slo_latency_burn", "/v1/retrieve")
+        spans, _ = tracer.buffer.since(0, limit=100000)
+    finally:
+        obs.shutdown()
+    for s in spans:
+        validate_span(s)
+    by_name: dict[str, list] = {}
+    for s in spans:
+        by_name.setdefault(s["name"], []).append(s)
+    door = by_name.get("health/door_state") or []
+    states = []
+    for s in door:
+        attrs = {a["key"]: a["value"] for a in s["attributes"]}
+        states.append(attrs["pathway.state"]["stringValue"])
+        if attrs["pathway.state"]["stringValue"] == "draining":
+            assert attrs["pathway.reason"] == {"stringValue": "rescale"}
+    assert states == ["ready", "syncing", "draining"], states
+    (fired,) = by_name["alert/fired"]
+    attrs = {a["key"]: a["value"] for a in fired["attributes"]}
+    assert attrs["pathway.alert"] == {"stringValue": "slo_latency_burn"}
+    assert attrs["pathway.fingerprint"] == {"stringValue": "/v1/retrieve"}
+    assert attrs["pathway.severity"] == {"stringValue": "page"}
+    assert attrs["pathway.summary"] == {"stringValue": "burn 16.7"}
+    (resolved,) = by_name["alert/resolved"]
+    attrs = {a["key"]: a["value"] for a in resolved["attributes"]}
+    assert attrs["pathway.alert"] == {"stringValue": "slo_latency_burn"}
+    # zero-duration event contract: start == end, same 32-hex run trace id
+    assert fired["startTimeUnixNano"] == fired["endTimeUnixNano"]
+    assert fired["traceId"] == door[0]["traceId"]
+
+
+def test_health_metric_line_shapes(monkeypatch):
+    """Unit (r21): the ``pathway_door_*`` / ``pathway_slo_*`` /
+    ``pathway_canary_*`` / ``pathway_alert_*`` series are well-formed
+    Prometheus exposition text with HELP/TYPE per series."""
+    import re
+
+    from pathway_tpu.internals.config import get_pathway_config
+    from pathway_tpu.observability import alerts as alerts_mod
+    from pathway_tpu.observability import health as health_mod
+
+    monkeypatch.setenv("PATHWAY_SLO_AVAILABILITY", "0.999")
+    health_mod.reset_slos()
+    try:
+        cfg = get_pathway_config()
+        plane = health_mod.HealthPlane(cfg)
+        plane.mark_ready()
+        pw.set_slo(route="/v1/retrieve", p99_ms=125.0)
+        plane.canary_total["/v1/retrieve"] = 7
+        plane.canary_failed["/v1/retrieve"] = 1
+        plane.canary_last_s["/v1/retrieve"] = 0.012345
+        plane.burn = {"latency:/v1/retrieve": {"fast": 16.7, "slow": 16.7}}
+        plane.budget_remaining = {"latency:/v1/retrieve": 0.0}
+        plane.registry = alerts_mod.AlertRegistry(cfg)
+        plane.registry.fire(
+            "slo_latency_burn", fingerprint="/v1/retrieve", severity="page"
+        )
+        lines = plane.prometheus_lines()
+    finally:
+        health_mod.reset_slos()
+    sample = re.compile(
+        r"^pathway_(door|slo|canary|alert|alerts)_[a-z_]+"
+        r"(\{[a-z_]+=\"[^\"]*\"(,[a-z_]+=\"[^\"]*\")*\})? "
+        r"-?\d+(\.\d+)?$"
+    )
+    for line in lines:
+        assert line.startswith("#") or sample.match(line), line
+    series = {line.split()[2] for line in lines if line.startswith("# TYPE")}
+    assert {
+        "pathway_door_ready",
+        "pathway_door_state",
+        "pathway_slo_target",
+        "pathway_slo_burn_rate",
+        "pathway_slo_error_budget_remaining",
+        "pathway_canary_requests_total",
+        "pathway_canary_failures_total",
+        "pathway_canary_latency_seconds",
+        "pathway_alert_active",
+        "pathway_alerts_fired_total",
+    } <= series, series
+    body = "\n".join(lines)
+    assert "pathway_door_ready 1" in body
+    assert 'pathway_door_state{state="ready"} 1' in body
+    assert 'pathway_door_state{state="draining"} 0' in body
+    assert 'pathway_slo_target{slo="availability"} 0.999' in body
+    # latency target exported in SECONDS
+    assert 'pathway_slo_target{slo="latency",route="/v1/retrieve"} 0.125' in body
+    assert (
+        'pathway_slo_burn_rate{slo="latency",route="/v1/retrieve",window="fast"} 16.7'
+        in body
+    )
+    assert 'pathway_canary_requests_total{route="/v1/retrieve"} 7' in body
+    assert 'pathway_canary_failures_total{route="/v1/retrieve"} 1' in body
+    assert (
+        'pathway_alert_active{alert="slo_latency_burn",fingerprint="/v1/retrieve"} 1'
+        in body
+    )
+    assert 'pathway_alerts_fired_total{alert="slo_latency_burn"} 1' in body
