@@ -1,0 +1,284 @@
+"""CDC round-trip benchmark: exactly-once delivery cost + recovery replay
+(ISSUE 19 acceptance gates).
+
+Two measurements over the r22 CDC workload (Debezium envelopes → join with a
+dimension table → windowed aggregation → kafka + postgres sinks):
+
+- **Round-trip throughput**: end-to-end envelopes/s for the full pipeline,
+  measured with delivery off (plain producers) and with
+  ``delivery="exactly_once"`` (ledger staging, epoch freeze at recovery
+  points, idempotent publish). Gate: the exactly-once path keeps at least
+  half the plain-path throughput (≤ 50% overhead) — the ledger is a
+  per-epoch batch append + one publish per recovery point, not a per-row
+  tax.
+
+- **Recovery replay at 10× history**: commit a run over ``H`` envelopes,
+  crash at the session boundary, relaunch with a small suffix — then repeat
+  with ``10×H`` history. Gate: recovery time grows ≤ 3× when history grows
+  10× (operator snapshots + the frozen delivery cut make recovery
+  O(state + suffix), not O(history)), and the replayed-event count stays
+  O(suffix).
+
+Noisy-host discipline: identical configs swinging > 1.6× across reps mean
+absolute ratios aren't trustworthy — gates then WARN instead of failing
+(same downgrade as ``observability_bench.py``), while staying hard on quiet
+hosts.
+
+Run: ``python benchmarks/cdc_bench.py [n_envelopes] [--out BENCH_r22.json]``.
+Prints one JSON line; ``--out`` also writes it to the given path.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+REPS = 3
+NAMES = ["alpha", "beta", "gamma"]
+
+
+def _feed(broker, topic: str, n: int, start: int = 0) -> None:
+    """n Debezium create-envelopes (plus an update per 8th id — retractions
+    keep the snapshot sink's diff-aware path honest)."""
+    for i in range(start, start + n):
+        row = {"id": i, "name": NAMES[i % 3], "amount": i % 997, "ts": i}
+        broker.produce(
+            topic,
+            json.dumps({"payload": {"op": "c", "before": None, "after": row}}),
+            key=json.dumps({"id": i}),
+        )
+        if i % 8 == 0:
+            new = dict(row, amount=row["amount"] + 1)
+            broker.produce(
+                topic,
+                json.dumps(
+                    {"payload": {"op": "u", "before": row, "after": new}}
+                ),
+                key=json.dumps({"id": i}),
+            )
+
+
+def _msg_count(n: int, start: int = 0) -> int:
+    return n + sum(1 for i in range(start, start + n) if i % 8 == 0)
+
+
+def _build(broker, pg_path: str, delivery: str | None):
+    import pathway_tpu as pw
+
+    class CdcS(pw.Schema):
+        id: int = pw.column_definition(primary_key=True)
+        name: str
+        amount: int
+        ts: int
+
+    events = pw.io.debezium.read(
+        broker, "cdc", schema=CdcS, mode="static", name="cdc"
+    )
+    dims = pw.debug.table_from_rows(
+        pw.schema_from_types(name=str, region=str),
+        [("alpha", "east"), ("beta", "west"), ("gamma", "south")],
+    )
+    joined = events.join(dims, events.name == dims.name).select(
+        region=dims.region,
+        amount=events.amount,
+        bucket=pw.apply_with_type(lambda t: t // 64, int, events.ts),
+    )
+    keyed = joined.select(
+        pw.this.amount,
+        wkey=pw.apply_with_type(
+            lambda r, b: "%s:%d" % (r, b), str, pw.this.region, pw.this.bucket
+        ),
+    )
+    win = keyed.groupby(pw.this.wkey).reduce(
+        pw.this.wkey,
+        total=pw.reducers.sum(pw.this.amount),
+        n=pw.reducers.count(),
+    )
+    from pathway_tpu.io._pg_fake import FakePostgres
+
+    pg = FakePostgres(pg_path)
+    if delivery:
+        pw.io.kafka.write(
+            win, broker, "out", format="json", key_column="wkey",
+            delivery=delivery, partitions=2,
+        )
+        pw.io.postgres.write_snapshot(
+            win, {"connection_factory": pg.connect}, "cdc_out",
+            primary_key=["wkey"], delivery=delivery,
+        )
+    else:
+        pw.io.kafka.write(win, broker, "out", format="json", key_column="wkey")
+        pw.io.postgres.write_snapshot(
+            win, {"connection_factory": pg.connect}, "cdc_out",
+            primary_key=["wkey"],
+        )
+
+
+def _fresh_pg(pg_path: str) -> None:
+    from pathway_tpu.io._pg_fake import FakePostgres
+
+    if os.path.exists(pg_path):
+        os.unlink(pg_path)
+    con = FakePostgres(pg_path).connect()
+    cur = con.cursor()
+    cur.execute(
+        "CREATE TABLE cdc_out (wkey TEXT PRIMARY KEY, total BIGINT, n BIGINT)"
+    )
+    con.commit()
+    con.close()
+
+
+def _roundtrip_once(root: str, n: int, delivery: str | None) -> float:
+    """One full pipeline lifetime over n envelopes; returns envelopes/s."""
+    import pathway_tpu as pw
+    from pathway_tpu.internals.parse_graph import G
+    from pathway_tpu.io.kafka import MockKafkaBroker
+
+    tag = delivery or "off"
+    broker_path = os.path.join(root, f"broker-{tag}")
+    pstore = os.path.join(root, f"pstore-{tag}")
+    pg_path = os.path.join(root, f"pg-{tag}.json")
+    shutil.rmtree(broker_path, ignore_errors=True)
+    shutil.rmtree(pstore, ignore_errors=True)
+    _fresh_pg(pg_path)
+    broker = MockKafkaBroker(path=broker_path)
+    broker.create_topic("cdc", 1)
+    _feed(broker, "cdc", n)
+
+    G.clear()
+    _build(broker, pg_path, delivery)
+    t0 = time.perf_counter()
+    pw.run(
+        monitoring_level="none",
+        persistence_config=pw.persistence.Config(
+            backend=pw.persistence.Backend.filesystem(pstore),
+            persistence_mode="operator_persisting",
+            snapshot_interval_ms=250,
+        ),
+    )
+    return n / (time.perf_counter() - t0)
+
+
+def _recovery(root: str, history: int, suffix: int, tag: str) -> dict:
+    """Commit a run over ``history`` envelopes, crash at the session
+    boundary, relaunch with ``suffix`` more — time the relaunch."""
+    import pathway_tpu as pw
+    from pathway_tpu.internals import telemetry
+    from pathway_tpu.internals.parse_graph import G
+    from pathway_tpu.io.kafka import MockKafkaBroker
+
+    broker_path = os.path.join(root, f"rbroker-{tag}")
+    pstore = os.path.join(root, f"rpstore-{tag}")
+    pg_path = os.path.join(root, f"rpg-{tag}.json")
+    shutil.rmtree(broker_path, ignore_errors=True)
+    shutil.rmtree(pstore, ignore_errors=True)
+    _fresh_pg(pg_path)
+    broker = MockKafkaBroker(path=broker_path)
+    broker.create_topic("cdc", 1)
+    _feed(broker, "cdc", history)
+
+    def session() -> float:
+        G.clear()
+        telemetry.clear_events()
+        _build(broker, pg_path, "exactly_once")
+        t0 = time.perf_counter()
+        pw.run(
+            monitoring_level="none",
+            persistence_config=pw.persistence.Config(
+                backend=pw.persistence.Backend.filesystem(pstore),
+                persistence_mode="operator_persisting",
+                snapshot_interval_ms=250,
+            ),
+        )
+        return time.perf_counter() - t0
+
+    session()  # ingest + commit; the "crash" is the session boundary
+    _feed(broker, "cdc", suffix, start=history)
+    dt = session()
+    replays = telemetry.events("resilience.replay")
+    return {
+        "history": history,
+        "suffix": suffix,
+        "recovery_seconds": round(dt, 3),
+        "replayed_events": sum(e["attrs"]["events"] for e in replays),
+    }
+
+
+def main() -> None:
+    import tempfile
+
+    args = sys.argv[1:]
+    out_path = None
+    if "--out" in args:
+        i = args.index("--out")
+        out_path = args[i + 1]
+        del args[i : i + 2]
+    n = int(args[0]) if args else 6000
+    history = max(500, n // 8)
+    suffix = max(50, history // 10)
+
+    results: dict = {"bench": "cdc_roundtrip", "n_envelopes": n, "reps": REPS}
+    rates: dict[str, list[float]] = {"off": [], "exactly_once": []}
+    with tempfile.TemporaryDirectory() as root:
+        for _ in range(REPS):
+            for mode in ("off", "exactly_once"):
+                rates[mode].append(
+                    _roundtrip_once(root, n, None if mode == "off" else mode)
+                )
+        rec_1x = _recovery(root, history, suffix, "1x")
+        rec_10x = _recovery(root, history * 10, suffix, "10x")
+
+    off = max(rates["off"])
+    eo = max(rates["exactly_once"])
+    results["rows_per_s_off"] = round(off, 1)
+    results["rows_per_s_exactly_once"] = round(eo, 1)
+    results["exactly_once_overhead_pct"] = round(100.0 * (1 - eo / off), 2)
+    spreads = [max(v) / max(1e-9, min(v)) for v in rates.values()]
+    results["rep_spread"] = round(max(spreads), 2)
+    results["noisy_host"] = max(spreads) > 1.6
+    results["recovery_1x"] = rec_1x
+    results["recovery_10x"] = rec_10x
+    ratio = rec_10x["recovery_seconds"] / max(1e-9, rec_1x["recovery_seconds"])
+    results["recovery_10x_ratio"] = round(ratio, 2)
+
+    throughput_ok = results["exactly_once_overhead_pct"] <= 50.0
+    # O(state + suffix) recovery: 10× history must not cost 10× — allow 3×
+    # (snapshot restore grows with state, and state grows with history here),
+    # and the replayed suffix must stay history-independent
+    recovery_ok = ratio <= 3.0 and rec_10x["replayed_events"] <= 4 * max(
+        1, rec_1x["replayed_events"], _msg_count(suffix, history * 10)
+    )
+    results["throughput_gate_ok"] = throughput_ok
+    results["recovery_gate_ok"] = recovery_ok
+    results["gate_ok"] = (throughput_ok and recovery_ok) or results["noisy_host"]
+
+    line = json.dumps(results)
+    print(line)
+    if out_path:
+        with open(out_path, "w") as f:
+            f.write(line + "\n")
+    if not throughput_ok:
+        print(
+            f"{'WARN (noisy host)' if results['noisy_host'] else 'FAIL'}: "
+            f"exactly-once overhead {results['exactly_once_overhead_pct']}% "
+            f"exceeds 50% budget (rep spread {results['rep_spread']}x)",
+            file=sys.stderr,
+        )
+    if not recovery_ok:
+        print(
+            f"{'WARN (noisy host)' if results['noisy_host'] else 'FAIL'}: "
+            f"recovery at 10x history cost {results['recovery_10x_ratio']}x "
+            f"(<=3.0), replayed {rec_10x['replayed_events']} events",
+            file=sys.stderr,
+        )
+    if not results["gate_ok"]:
+        sys.exit(1)
+
+
+if __name__ == "__main__":
+    main()

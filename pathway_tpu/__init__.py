@@ -85,7 +85,7 @@ from pathway_tpu.internals.exported import ExportedTable, export_table, import_t
 from pathway_tpu.internals.parse_graph import G
 
 # subpackages ----------------------------------------------------------------
-from pathway_tpu import debug, demo, elastic, fabric, flow, io, observability, persistence, resilience, stdlib, universes
+from pathway_tpu import debug, delivery, demo, elastic, fabric, flow, io, observability, persistence, resilience, stdlib, universes
 from pathway_tpu.stdlib import temporal, indexing, ml, graphs, statistical, stateful
 from pathway_tpu.stdlib import utils as utils
 from pathway_tpu.stdlib.utils.async_transformer import AsyncTransformer
@@ -204,6 +204,7 @@ __all__ = [
     "udf",
     "unwrap",
     "debug",
+    "delivery",
     "demo",
     "io",
     "flow",

@@ -1901,6 +1901,40 @@ class JoinNode(Node):
 
         return key_fn
 
+    def migrate_restore(self, shards: list[dict], keep) -> dict | None:
+        """O(moved-state) rescale merge: both arrangements and the outer-pad
+        counts are addressed by the join key — the same key ``exchange_key``
+        routes by — so old shards are jk-disjoint and a filtered union of
+        their live rows rebuilds this worker's state. Tombstoned rows are
+        dropped in transit (``iter_live``), so the migrated store starts
+        compacted."""
+        store = [
+            ColumnarMultimap(len(self.left_cols)),
+            ColumnarMultimap(len(self.right_cols)),
+        ]
+        jk_counts = [SortedCounts(), SortedCounts()]
+        moved = 0
+        for s in shards:
+            for side in (0, 1):
+                for jk, rk, cols in s["store"][side].iter_live():
+                    if not len(jk):
+                        continue
+                    mask = keep(jk)
+                    if mask.any():
+                        store[side].insert(
+                            jk[mask], rk[mask], [c[mask] for c in cols]
+                        )
+                        moved += int(mask.sum())
+                sc = s["jk_counts"][side]
+                if len(sc.keys):
+                    mask = keep(sc.keys) & (sc.counts != 0)
+                    if mask.any():
+                        jk_counts[side].add(sc.keys[mask], sc.counts[mask])
+                        moved += int(mask.sum())
+        if not moved:
+            return None
+        return {"store": store, "jk_counts": jk_counts}
+
     def __init__(
         self,
         left_cols: list[str],

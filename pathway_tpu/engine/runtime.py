@@ -157,6 +157,17 @@ class Runtime:
         scheduler.tracer = tracer
         self.scheduler = scheduler
 
+        if self.persistence is None and any(
+            getattr(node, "delivery_writer", None) is not None
+            for _lnode, node in ctx.build_order
+        ):
+            raise RuntimeError(
+                "delivery='exactly_once' sinks need persistence: the ledger "
+                "stages output in the persistence backend and publishes at "
+                "operator-snapshot recovery points — pass "
+                "persistence_config=pw.persistence.Config(..., "
+                "persistence_mode='operator_persisting') to pw.run"
+            )
         if self.persistence is not None:
             # replay snapshots into input nodes before live reads (reference:
             # rewind to sentinel, then seek, src/connectors/mod.rs:100-105)

@@ -1002,6 +1002,39 @@ class PathwayConfig:
         fast window that raise ``heartbeat_flap``."""
         return max(1, _env_int("PATHWAY_ALERT_HEARTBEAT_FLAPS", 3))
 
+    @property
+    def alert_sink_stall_s(self) -> float:
+        """Sink-commit-stall detector: a staged-but-unpublished delivery epoch
+        older than this many seconds raises ``sink_commit_stall`` (the sink's
+        transport keeps failing and output is piling up in the ledger)."""
+        return max(1.0, _env_float("PATHWAY_ALERT_SINK_STALL_S", 120.0))
+
+    # ---- exactly-once delivery (r22) ----------------------------------------
+    @property
+    def delivery(self) -> str:
+        """Default delivery mode for sink writers that don't pass an explicit
+        ``delivery=``: ``off`` (direct at-least-once writes) or
+        ``exactly_once`` (epoch-transactional through the delivery ledger)."""
+        v = os.environ.get("PATHWAY_DELIVERY", "off")
+        if v not in ("off", "exactly_once"):
+            raise ValueError(
+                f"PATHWAY_DELIVERY must be 'off' or 'exactly_once', got {v!r}"
+            )
+        return v
+
+    @property
+    def delivery_stage_rows(self) -> int:
+        """Rows per staged ledger chunk (the r13 chunk-store discipline:
+        bounded put sizes however large one epoch's output gets)."""
+        return max(1, _env_int("PATHWAY_DELIVERY_STAGE_ROWS", 65536))
+
+    @property
+    def delivery_max_staged_epochs(self) -> int:
+        """Backpressure bound on staged-but-unpublished epochs per sink: past
+        this depth the run fails rather than staging unbounded output against
+        a sink that never accepts it."""
+        return max(1, _env_int("PATHWAY_DELIVERY_MAX_STAGED_EPOCHS", 512))
+
     # ---- helpers ------------------------------------------------------------
     @property
     def total_workers(self) -> int:
@@ -1094,6 +1127,10 @@ class PathwayConfig:
                 "alert_backlog_rows",
                 "alert_thrash_decisions",
                 "alert_heartbeat_flaps",
+                "alert_sink_stall_s",
+                "delivery",
+                "delivery_stage_rows",
+                "delivery_max_staged_epochs",
                 "run_id",
                 "engine_phases",
                 "device_exchange_fused",

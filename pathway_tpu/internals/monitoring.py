@@ -113,6 +113,14 @@ def run_stats(runtime) -> dict[str, Any]:
         # event log the OTLP exports consume (``internals/telemetry.py``)
         "resilience": resilience_summary(),
     }
+    # exactly-once delivery plane (r22): per-sink staged/published frontiers,
+    # uncommitted-epoch depth and publish failures (present only when a sink
+    # opted into delivery="exactly_once")
+    from pathway_tpu import delivery as _delivery
+
+    delivery_summary = _delivery.run_summary(runtime)
+    if delivery_summary is not None:
+        stats["delivery"] = delivery_summary
     # flow-control plane (PATHWAY_FLOW=on): per-input credit/occupancy/shed
     # counters and the AIMD controller's recent decisions — shedding is only
     # acceptable because every drop is visible here
@@ -361,6 +369,10 @@ def prometheus_text(runtime) -> str:
     from pathway_tpu.observability import health as _health
 
     lines.extend(_health.prometheus_lines(runtime))
+    # ---- exactly-once delivery plane (staged/published/uncommitted) ---------
+    from pathway_tpu import delivery as _delivery_mod
+
+    lines.extend(_delivery_mod.prometheus_lines(runtime))
     # ---- embedding memo (hit ratio + shared tier) ---------------------------
     import sys as _sys
 

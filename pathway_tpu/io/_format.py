@@ -36,10 +36,15 @@ class RawMessage:
 @dataclass
 class ParsedEvent:
     """Typed row delta produced by a parser (``ParsedEvent::{Insert,Delete}``,
-    ``data_format.rs:93``). ``values`` follow the parser schema's column order."""
+    ``data_format.rs:93``). ``values`` follow the parser schema's column order.
+    ``tombstone`` marks a Debezium null-payload key-death record: ``values``
+    carry only the primary-key fields (from the message key) and consumers in
+    upsert sessions translate it to a keyed delete, while diff-native sessions
+    ignore it (the preceding ``op: d`` event already retracted the row)."""
 
     values: tuple
     diff: int = 1
+    tombstone: bool = False
 
 
 def coerce_scalar(tok: Any, d: dt.DType) -> Any:
@@ -198,11 +203,51 @@ def rows_from_bytes(data: bytes, fmt: str, schema) -> list[tuple]:
 
 class DebeziumMessageParser(Parser):
     """CDC envelopes: ``{"payload": {"op": c|r|u|d, "before": …, "after": …}}``
-    (reference ``DebeziumMessageParser:1433``, standard + MongoDB dialects)."""
+    (reference ``DebeziumMessageParser:1433``, standard + MongoDB dialects).
+
+    All four ops are handled: ``c``/``r`` insert ``after``; ``u`` retracts
+    ``before`` and inserts ``after``; ``d`` retracts ``before``. Messages may
+    arrive with or without the Connect ``{"schema": …, "payload": …}`` wrapper
+    (both key and value sides). A null value / ``"payload": null`` is the
+    Debezium log-compaction tombstone: with ``tombstones=True`` it parses into
+    a pk-only event (pk fields unwrapped from the message key, ``diff=-1``,
+    ``tombstone=True``) that upsert consumers turn into a keyed delete;
+    with the default ``tombstones=False`` it is silently skipped — diff-native
+    consumers already saw the retraction in the preceding ``op: d`` event."""
+
+    def __init__(self, schema, tombstones: bool = False):
+        super().__init__(schema)
+        self.tombstones = tombstones
+
+    @staticmethod
+    def _unwrap(rec):
+        """Strip the Kafka Connect schema block when present."""
+        if isinstance(rec, dict) and "payload" in rec:
+            return rec["payload"]
+        return rec
+
+    def _tombstone(self, message: RawMessage) -> list[ParsedEvent]:
+        if not self.tombstones or message.key is None:
+            return []
+        try:
+            krec = _json.loads(_as_text(message.key))
+        except ValueError:
+            return []
+        kpayload = self._unwrap(krec)
+        if not isinstance(kpayload, dict):
+            return []
+        return [
+            ParsedEvent(self._row_from_mapping(kpayload), diff=-1, tombstone=True)
+        ]
 
     def parse(self, message: RawMessage) -> list[ParsedEvent]:
-        rec = _json.loads(_as_text(message.value))
-        payload = rec.get("payload", rec)
+        text = _as_text(message.value).strip() if message.value is not None else ""
+        if not text or text == "null":
+            return self._tombstone(message)
+        rec = _json.loads(text)
+        payload = self._unwrap(rec)
+        if payload is None:
+            return self._tombstone(message)
         op = payload.get("op", "c")
         before, after = payload.get("before"), payload.get("after")
         if isinstance(before, str):  # MongoDB dialect ships embedded JSON strings

@@ -2,7 +2,11 @@
 ``DebeziumMessageParser``, ``src/connectors/data_format.rs:1433``).
 
 Consumes Debezium change envelopes from a Kafka topic; ``op`` c/r/u/d become
-insert/retract deltas keyed by the schema's primary keys."""
+insert/retract deltas keyed by the schema's primary keys. Envelopes arrive
+with or without the Connect schema block, and null-payload log-compaction
+tombstones parse into keyed deletes (``tombstones=True``, the default here —
+harmless for the default diff-native session, which drops the valueless
+event because the ``op: d`` envelope already retracted the row)."""
 
 from __future__ import annotations
 
@@ -21,6 +25,7 @@ def read(
     schema: schema_mod.SchemaMetaclass,
     mode: str = "streaming",
     name: str | None = None,
+    tombstones: bool = True,
     **kwargs: Any,
 ) -> Table:
     if not schema.primary_key_columns():
@@ -29,7 +34,7 @@ def read(
         broker,
         topic,
         schema=schema,
-        parser=DebeziumMessageParser(schema),
+        parser=DebeziumMessageParser(schema, tombstones=tombstones),
         format="debezium",
         mode=mode,
         name=name or f"debezium:{topic}",

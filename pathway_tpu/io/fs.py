@@ -160,6 +160,7 @@ def write(
     format: str = "csv",  # noqa: A002
     sharded: bool = False,
     service_class: str = "interactive",
+    delivery: str | None = None,
     **kwargs: Any,
 ) -> None:
     """Append output diffs to a file with time/diff columns (reference FileWriter +
@@ -172,12 +173,31 @@ def write(
     disk per process (no cross-process close ordering) — consume them as a
     part-file set, Spark-style.
 
+    ``delivery="exactly_once"`` re-expresses the writer over the delivery
+    ledger: formatted lines stage durably per epoch and append to the file
+    only at operator-snapshot recovery points, guarded by the
+    ``<filename>.delivery`` offset sidecar (truncate-to-offset on re-publish)
+    — the file's bytes are identical across SIGKILL/restart. Not combinable
+    with ``sharded=True`` (the ledger funnels through the SOLO sink path).
+
     ``service_class="bulk"`` excludes this writer's end-to-end latency from
     the flow plane's SLO (an fsync-bound audit mirror must not drag the AIMD
     microbatch bucket down)."""
     from pathway_tpu.flow import validate_service_class
 
     service_class = validate_service_class(service_class)
+    from pathway_tpu import delivery as _delivery
+
+    if _delivery.resolve_mode(delivery) == "exactly_once":
+        if sharded:
+            raise ValueError(
+                "fs.write: delivery='exactly_once' routes every row through "
+                "the SOLO delivery ledger and cannot be combined with "
+                "sharded=True"
+            )
+        return _write_ledger(
+            table, filename, format=format, service_class=service_class
+        )
     if sharded:
         return _write_sharded(
             table, filename, format=format, service_class=service_class, **kwargs
@@ -273,6 +293,44 @@ def write(
             restore_sink=restore_sink if owner else None,
             service_class=service_class,
         )
+
+    LogicalNode(factory, [table._node], name=f"fs_write:{filename}")._register_as_output()
+
+
+def _write_ledger(
+    table: Table,
+    filename: str,
+    *,
+    format: str,  # noqa: A002
+    service_class: str = "interactive",
+) -> None:
+    """The fs sink re-expressed over the delivery ledger API: rows buffer as
+    formatted lines, stage per epoch, and the FsDeliveryTransport appends them
+    behind the offset sidecar at recovery points."""
+    from pathway_tpu import delivery as _delivery
+
+    parent = os.path.dirname(os.path.abspath(filename))
+    if not os.path.isdir(parent):
+        raise FileNotFoundError(f"fs.write: output directory does not exist: {parent}")
+    cols = table.column_names()
+    line_fn, header = _row_formatter(format, cols)
+    transport = _delivery.FsDeliveryTransport(filename, header=header)
+    writer = _delivery.LedgerWriter(f"fs.{filename}", transport)
+
+    def on_batch(batch: DeltaBatch, columns: list[str]) -> None:
+        for _key, diff, row in batch.rows():
+            writer.append(0, line_fn(row, batch.time, diff))
+
+    def factory() -> Node:
+        node = ops.CallbackOutputNode(
+            cols,
+            on_batch,
+            sink_state=writer.sink_state,
+            restore_sink=writer.restore_sink,
+            service_class=service_class,
+        )
+        node.delivery_writer = writer
+        return node
 
     LogicalNode(factory, [table._node], name=f"fs_write:{filename}")._register_as_output()
 

@@ -53,15 +53,20 @@ class MockKafkaBroker:
     # ---- admin ----
     def create_topic(self, topic: str, partitions: int = 1) -> None:
         with self._lock:
-            if self.path:
-                tdir = os.path.join(self.path, topic)
-                os.makedirs(tdir, exist_ok=True)
-                for p in range(partitions):
-                    fp = self._file(topic, p)
-                    if not os.path.exists(fp):
-                        open(fp, "a").close()
-            else:
-                self._mem.setdefault(topic, [[] for _ in range(partitions)])
+            self._ensure_topic_locked(topic, partitions)
+
+    def _ensure_topic_locked(self, topic: str, partitions: int) -> None:
+        if self.path:
+            tdir = os.path.join(self.path, topic)
+            os.makedirs(tdir, exist_ok=True)
+            for p in range(partitions):
+                fp = self._file(topic, p)
+                if not os.path.exists(fp):
+                    open(fp, "a").close()
+        else:
+            plist = self._mem.setdefault(topic, [])
+            while len(plist) < partitions:
+                plist.append([])
 
     def partitions(self, topic: str) -> int:
         if self.path:
@@ -81,6 +86,7 @@ class MockKafkaBroker:
         value: bytes | str,
         key: bytes | str | None = None,
         partition: int | None = None,
+        headers: dict | None = None,
     ) -> None:
         n = self.partitions(topic)
         if n == 0:
@@ -88,21 +94,57 @@ class MockKafkaBroker:
             n = 1
         if partition is None:
             partition = (hash(key) % n) if key is not None else 0
+        with self._lock:
+            self._append_locked(topic, partition, key, value, headers)
+
+    def produce_batch(self, msgs: list[dict], marker: dict | None = None) -> None:
+        """Atomically append a batch (each msg ``{"topic", "partition", "key",
+        "value", "headers"}``) plus an optional trailing commit marker — the
+        delivery plane's single-locked-append publish path: a concurrent
+        ``fetch`` never observes a marker without its batch."""
+        all_msgs = list(msgs)
+        if marker is not None:
+            all_msgs.append(dict(marker))
+        with self._lock:
+            for m in all_msgs:
+                p = m.get("partition")
+                self._append_locked(
+                    m["topic"],
+                    0 if p is None else int(p),
+                    m.get("key"),
+                    m["value"],
+                    m.get("headers"),
+                )
+
+    def _append_locked(self, topic, partition, key, value, headers=None) -> None:
+        self._ensure_topic_locked(topic, partition + 1)
         if isinstance(value, bytes):
             value = value.decode(errors="replace")
         if isinstance(key, bytes):
             key = key.decode(errors="replace")
-        with self._lock:
-            if self.path:
-                with open(self._file(topic, partition), "a") as fh:
-                    fh.write(_json.dumps({"k": key, "v": value}) + "\n")
-                    fh.flush()
-            else:
-                self._mem[topic][partition].append((key, value))
+        rec: dict = {"k": key, "v": value}
+        if headers:
+            # optional jsonl key: logs written before headers existed (or by
+            # plain producers) read back with h absent
+            rec["h"] = dict(headers)
+        if self.path:
+            with open(self._file(topic, partition), "a") as fh:
+                fh.write(_json.dumps(rec) + "\n")
+                fh.flush()
+        else:
+            self._mem[topic][partition].append(rec)
 
     # ---- consume ----
     def fetch(self, topic: str, partition: int, offset: int) -> list[tuple[Any, Any]]:
         """All messages in ``partition`` from ``offset`` (message index) on."""
+        return [
+            (r["k"], r["v"]) for r in self.fetch_records(topic, partition, offset)
+        ]
+
+    def fetch_records(self, topic: str, partition: int, offset: int) -> list[dict]:
+        """Like :meth:`fetch` but returns full records (``{"k", "v"}`` plus
+        ``"h"`` headers when present) — ``delivery.read_committed`` needs the
+        idempotence headers to dedupe crash-window re-publishes."""
         if self.path:
             fp = self._file(topic, partition)
             if not os.path.exists(fp):
@@ -112,12 +154,13 @@ class MockKafkaBroker:
                 for i, line in enumerate(fh):
                     if i < offset or not line.strip():
                         continue
-                    rec = _json.loads(line)
-                    out.append((rec["k"], rec["v"]))
+                    out.append(_json.loads(line))
             return out
         with self._lock:
-            msgs = self._mem.get(topic, [[]])[partition]
-            return list(msgs[offset:])
+            plist = self._mem.get(topic)
+            if plist is None or partition >= len(plist):
+                return []
+            return [dict(r) for r in plist[partition][offset:]]
 
 
 def _client_module(settings: dict):
@@ -260,7 +303,11 @@ def _read_real(
                                 _kafka_event_key(
                                     self, topic, msg.partition(), msg.offset(), j, ev.values
                                 ),
-                                ev.values,
+                                # Debezium tombstones: pk-keyed valueless event
+                                # — upsert sessions delete, native sessions
+                                # drop it (the op:"d" envelope already
+                                # retracted the row)
+                                None if ev.tombstone else ev.values,
                                 ev.diff,
                             )
                             for j, ev in enumerate(
@@ -387,7 +434,9 @@ def read(
                                         _kafka_event_key(
                                             self, topic, p, off + i, j, ev.values
                                         ),
-                                        ev.values,
+                                        # tombstone → valueless keyed event
+                                        # (upsert deletes, native drops)
+                                        None if ev.tombstone else ev.values,
                                         ev.diff,
                                     )
                                 )
@@ -433,15 +482,62 @@ def write(
     format: str = "json",  # noqa: A002
     formatter: Formatter | None = None,
     key_column: str | None = None,
+    delivery: str | None = None,
+    partitions: int = 1,
     **kwargs: Any,
 ) -> None:
-    """Produce every output diff of ``table`` to ``topic``."""
+    """Produce every output diff of ``table`` to ``topic``.
+
+    ``delivery="exactly_once"`` (or ``PATHWAY_DELIVERY=exactly_once``) routes
+    rows through the durable delivery ledger: staged per epoch, frozen at
+    operator-snapshot recovery points, and published transactionally (real
+    clients with ``transactional.id``) or with ``(sink, epoch, partition,
+    seq)`` dedupe headers that ``delivery.read_committed`` consumers drop —
+    byte-identical downstream state across SIGKILL/restart/rescale.
+    ``partitions`` spreads delivery output across mock-broker partitions by a
+    stable hash of the message key."""
     from pathway_tpu.engine import operators as ops
     from pathway_tpu.internals.logical import LogicalNode
 
     cols = table.column_names()
     fmt = formatter or formatter_for(format, cols, **kwargs)
     key_idx = cols.index(key_column) if key_column else None
+
+    from pathway_tpu import delivery as _delivery
+
+    if _delivery.resolve_mode(delivery) == "exactly_once":
+        n_parts = max(1, partitions)
+        if not isinstance(broker, dict):
+            broker.create_topic(topic, n_parts)
+        transport = _delivery.KafkaDeliveryTransport(broker, topic)
+        writer = _delivery.LedgerWriter(f"kafka.{topic}", transport)
+
+        def on_batch_ledger(batch, columns) -> None:
+            for key, diff, row in batch.rows():
+                payload = fmt.format(int(key), row, batch.time, diff)
+                if isinstance(payload, bytes):
+                    payload = payload.decode(errors="replace")
+                mkey = str(row[key_idx]) if key_idx is not None else None
+                writer.append(
+                    _delivery.stable_partition(mkey, n_parts), (mkey, payload)
+                )
+
+        def _ledger_node():
+            node = ops.CallbackOutputNode(
+                cols,
+                on_batch_ledger,
+                sink_state=writer.sink_state,
+                restore_sink=writer.restore_sink,
+            )
+            # the persistence plane scans for this attribute to bind the
+            # writer's ledger at graph build (snapshots._bind_delivery)
+            node.delivery_writer = writer
+            return node
+
+        LogicalNode(
+            _ledger_node, [table._node], name=f"kafka_write:{topic}"
+        )._register_as_output()
+        return
 
     if isinstance(broker, dict):
         # wire-protocol producer (reference KafkaWriter, data_storage.rs:1406)

@@ -103,6 +103,15 @@ def local_summary(runtime) -> dict[str, Any]:
     hb = _health.heartbeat_summary()
     if hb is not None:
         summary["health"] = hb
+    # exactly-once delivery plane: staged/published totals, uncommitted-epoch
+    # depth and the oldest unpublished stage time ride the heartbeat so the
+    # coordinator sees a stalling sink on any process (only process 0 binds
+    # sinks today, but the rollup is shape-agnostic)
+    from pathway_tpu import delivery as _delivery
+
+    dlv = _delivery.heartbeat_summary(runtime)
+    if dlv is not None:
+        summary["delivery"] = dlv
     return summary
 
 
@@ -221,5 +230,22 @@ def cluster_status(runtime) -> dict[str, Any] | None:
             "active_alerts": sorted(active_alerts),
             "alerts_fired": fired,
             "canary": canary,
+        }
+    # delivery rollup: pod-wide staged/published totals, the deepest
+    # uncommitted-epoch backlog and the oldest unpublished stage time
+    dlvs = [p.get("delivery") for p in processes.values() if p.get("delivery")]
+    if dlvs:
+        oldest = [
+            d["oldest_unpublished_unix"]
+            for d in dlvs
+            if d.get("oldest_unpublished_unix") is not None
+        ]
+        out["delivery"] = {
+            "sinks": sum(d.get("sinks") or 0 for d in dlvs),
+            "depth_max": max(d.get("depth") or 0 for d in dlvs),
+            "staged_rows": sum(d.get("staged") or 0 for d in dlvs),
+            "published_rows": sum(d.get("published") or 0 for d in dlvs),
+            "publish_failures": sum(d.get("failures") or 0 for d in dlvs),
+            "oldest_unpublished_unix": min(oldest) if oldest else None,
         }
     return out

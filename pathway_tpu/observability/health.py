@@ -627,6 +627,35 @@ class HealthPlane:
                     )
         except Exception:
             pass
+        # sink commit stall: a staged-but-unpublished delivery epoch whose age
+        # exceeds the bound — the sink's transport keeps failing and exactly-
+        # once output is piling up in the ledger
+        try:
+            from pathway_tpu import delivery as _delivery
+
+            plane = _delivery.plane_of(self.runtime)
+            if plane is not None:
+                now_unix = _time.time()
+                for w in plane.writers:
+                    oldest = w.oldest_unpublished_unix()
+                    if oldest is None:
+                        continue
+                    age = now_unix - oldest
+                    if age > cfg.alert_sink_stall_s:
+                        breaches.append(
+                            {
+                                "alert": "sink_commit_stall",
+                                "fingerprint": w.sink_id,
+                                "summary": (
+                                    f"sink {w.sink_id} has unpublished output "
+                                    f"staged {age:.0f}s ago ({w.depth()} "
+                                    f"epochs deep; last error: "
+                                    f"{w.last_publish_error})"
+                                ),
+                            }
+                        )
+        except Exception:
+            pass
         return breaches
 
     # ------------------------------------------------------------- readers

@@ -552,6 +552,9 @@ class Persistence:
         self.inputs: list[_PersistedInput] = []
         self.opsnap: _OperatorSnapshots | None = None
         self.epochs: _EpochLog | None = None
+        #: exactly-once delivery plane (r22) — bound on process 0 / the solo
+        #: runtime when any sink writer opted into delivery="exactly_once"
+        self.delivery = None
         self.replayed_events = 0
         self._worker_nodes: dict[int, list] = {}
         self._node_names: list = []
@@ -689,6 +692,13 @@ class Persistence:
             self._add_partitioned_peer_inputs(offsets)
             self._replay_all()
             return
+        # exactly-once delivery (r22): bind ledger writers AFTER the operator
+        # restore above (restore_sink has delivered each sink's snapshot cut)
+        # and BEFORE replay queues any input — binding discards orphan staged
+        # epochs past the cut and resumes publication of frozen epochs the
+        # previous process died before handing to the sink. Sinks are SOLO
+        # (global worker 0), so only process 0 / the solo runtime binds.
+        self._bind_delivery(ctx)
         # pid stability: a source keeps its snapshots across unrelated pipeline
         # edits — use the connector's name alone when unique among sources, and
         # only disambiguate same-named sources by their order among sources
@@ -1102,6 +1112,41 @@ class Persistence:
                         )
                     )
 
+    def _bind_delivery(self, ctx) -> None:
+        """Collect the sink writers that opted into ``delivery='exactly_once'``
+        (their CallbackOutputNodes carry a ``delivery_writer`` attribute) and
+        bind them to the persistence backend. Runs on process 0 / the solo
+        runtime only — sinks are SOLO nodes living on global worker 0, and the
+        restore above already delivered each writer's snapshot cut through its
+        ``restore_sink`` hook."""
+        writers: list = []
+        seen: set[int] = set()
+        for _lnode, node in ctx.build_order:
+            w = getattr(node, "delivery_writer", None)
+            if w is not None and id(w) not in seen:
+                seen.add(id(w))
+                writers.append(w)
+        if not writers:
+            return
+        if not self.operator_mode:
+            raise RuntimeError(
+                "delivery='exactly_once' requires "
+                "persistence_mode='operator_persisting': publication gates on "
+                "operator-snapshot recovery points (a replayed suffix re-nets "
+                "ticks, so per-epoch output cannot be aligned with what was "
+                "already published)"
+            )
+        from pathway_tpu.delivery import DeliveryPlane
+
+        plane = DeliveryPlane(
+            writers, self.backend, next_epoch=lambda: self.epochs.epoch + 1
+        )
+        plane.bind_all(
+            rescaled=self._migrate_plan is not None
+            or self._reshard_restore is not None
+        )
+        self.delivery = plane
+
     def _subject_of(self, node) -> Any:
         """Find the connector subject feeding ``node`` (for seekable sources)."""
         for driver in getattr(self.runtime, "connectors", []) or []:
@@ -1118,6 +1163,12 @@ class Persistence:
         if self.epochs is not None:
             self.epochs.commit(time, offsets, opsnap_gen=gen, force=True)
         self._trim_inputs(lambda p: offsets[p.pid])
+        if self.delivery is not None:
+            # the snapshot that just committed carried each sink's staged cut:
+            # everything at or below it is frozen — hand it to the sinks (a
+            # failure here is retried at the next recovery point; strict only
+            # at close, where unpublished output would otherwise be silent)
+            self.delivery.publish_committed(final=time < 0)
 
     def _trim_inputs(self, offset_of) -> None:
         """Log compaction after a durable operator commit — SUSPENDED while
@@ -1188,27 +1239,42 @@ class Persistence:
         self._trim_inputs(lambda p: decision["offsets"].get(p.pid, 0))
         self.opsnap.flush_aux_gc()  # each process GCs its own shards' chunks
         self.opsnap.advance()
+        # delivery publication only after the commit_done barrier: every peer
+        # has acked the manifest durable, so the frozen cut can never roll back
+        if self._pid == 0 and self.delivery is not None:
+            self.delivery.publish_committed(final=time < 0)
 
-    def _commit_epoch(self, time: int) -> None:
+    def _commit_epoch(self, time: int, force: bool = False) -> None:
         """Input-frontier epochs: after this tick's flushes, publish a global
         epoch manifest of the durable per-source offsets. In cluster mode a
         barrier first collects every process's flushed offsets — the commit
-        is by construction 'all processes reported durable'."""
+        is by construction 'all processes reported durable'. ``force`` commits
+        even when the frontier did not move (the delivery plane staged output
+        rows this tick, which must map onto a committed epoch number)."""
         if not self._is_cluster:
             if self.epochs is not None:
-                self.epochs.commit(time, {p.pid: p.persisted for p in self.inputs})
+                self.epochs.commit(
+                    time, {p.pid: p.persisted for p in self.inputs}, force=force
+                )
             return
         local = {p.pid: p.persisted for p in self.inputs}
         decision = self.runtime._barrier(
             ("epoch", self._pid, local), self._merge_offsets
         )
         if self.epochs is not None:  # process 0 is the single epoch writer
-            self.epochs.commit(time, decision["offsets"], acks=decision["acks"])
+            self.epochs.commit(
+                time, decision["offsets"], acks=decision["acks"], force=force
+            )
 
     def on_tick_done(self, time: int) -> None:
         for p in self.inputs:
             p.flush()
-        self._commit_epoch(time)
+        # exactly-once delivery (r22): durably stage this tick's output rows
+        # under epoch N+1 BEFORE the epoch commit — once the manifest lands the
+        # staged batch is addressable; a crash in between leaves an orphan
+        # index that restore discards (see delivery/ledger.py crash windows)
+        staged = self.delivery.stage_tick() if self.delivery is not None else 0
+        self._commit_epoch(time, force=staged > 0)
         if not self.operator_mode or self.opsnap is None:
             return
         if not self._is_cluster:
@@ -1231,6 +1297,10 @@ class Persistence:
     def on_close(self) -> None:
         for p in self.inputs:
             p.flush()
+        if self.delivery is not None:
+            # rows emitted since the last tick boundary: stage them under one
+            # final epoch so the closing snapshot freezes and publishes them
+            self.delivery.stage_tick()
         if not self.operator_mode or self.opsnap is None:
             self._commit_epoch(-1)
             return

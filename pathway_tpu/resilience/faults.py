@@ -13,6 +13,8 @@ Plan syntax (``;``-separated steps, each ``action:key=val,key=val``)::
     delay_barrier:proc=0,tick=4,ms=250,count=1  # delay 1 barrier call >=t4
     flip_diff:proc=0,tick=3          # negate one polled row's diff sign at t>=3
     drop_retract:proc=0,tick=3       # drop one polled retraction row at t>=3
+    kill_point:point=delivery_staged  # SIGKILL at the Nth (count=) pass of a
+                                      # named code point (faults.at_point)
 
 Semantics:
 
@@ -25,6 +27,10 @@ Semantics:
 - ``delay_barrier`` sleeps ``ms`` before the next ``count`` barrier
   participations at or after ``tick`` (simulates a slow/hung peer without
   killing it — the heartbeat-timeout detection path).
+- ``kill_point`` SIGKILLs at a NAMED code point instead of a tick boundary —
+  subsystems bracket their crash windows with ``faults.at_point("<name>")``
+  (e.g. the delivery plane's ``delivery_staged`` between the durable output
+  stage and the manifest commit). ``count=N`` fires on the Nth pass.
 - ``flip_diff`` / ``drop_retract`` are **data-plane corruptions** for testing
   the audit plane (``PATHWAY_AUDIT``) end-to-end: applied to freshly polled
   input blocks AFTER the connector/upsert machinery but BEFORE the audit
@@ -51,11 +57,12 @@ from dataclasses import dataclass, field
 
 @dataclass
 class FaultSpec:
-    action: str  # kill | drop_poll | delay_barrier
+    action: str  # kill | drop_poll | delay_barrier | kill_point | ...
     proc: int | None = None  # None = any process
     tick: int = 0
     count: int = 1
     ms: float = 0.0
+    point: str = ""  # kill_point: the named code point to fire at
     remaining: int = field(default=-1, repr=False)  # -1 = init from count
 
     def __post_init__(self) -> None:
@@ -66,7 +73,14 @@ class FaultSpec:
         return self.proc is None or self.proc == proc
 
 
-_ACTIONS = ("kill", "drop_poll", "delay_barrier", "flip_diff", "drop_retract")
+_ACTIONS = (
+    "kill",
+    "drop_poll",
+    "delay_barrier",
+    "flip_diff",
+    "drop_retract",
+    "kill_point",
+)
 
 
 class FaultPlan:
@@ -104,8 +118,12 @@ class FaultPlan:
                     kwargs["count"] = int(v)
                 elif k == "ms":
                     kwargs["ms"] = float(v)
+                elif k == "point":
+                    kwargs["point"] = v.strip()
                 else:
                     raise ValueError(f"unknown fault option {k!r} in {step!r}")
+            if action == "kill_point" and not kwargs.get("point"):
+                raise ValueError(f"kill_point requires point= in {step!r}")
             specs.append(FaultSpec(action=action, **kwargs))
         return cls(specs)
 
@@ -124,7 +142,10 @@ class FaultPlan:
             kvs = []
             if s.proc is not None:
                 kvs.append(f"proc={s.proc}")
-            kvs.append(f"tick={s.tick}")
+            if s.action == "kill_point":
+                kvs.append(f"point={s.point}")
+            else:
+                kvs.append(f"tick={s.tick}")
             if s.count != 1:
                 kvs.append(f"count={s.count}")
             if s.ms:
@@ -164,6 +185,21 @@ class FaultPlan:
                     continue
                 s.remaining -= 1
                 return s
+        return None
+
+    def take_point_kill(self, name: str, proc: int) -> FaultSpec | None:
+        """One ``kill_point`` firing for this named code point (``count=N``
+        fires on the Nth pass), or None."""
+        for s in self.specs:
+            if (
+                s.action == "kill_point"
+                and s.point == name
+                and s.matches_proc(proc)
+                and s.remaining > 0
+            ):
+                s.remaining -= 1
+                if s.remaining == 0:
+                    return s
         return None
 
     def take_barrier_delay(self, proc: int, tick: int) -> FaultSpec | None:
@@ -228,6 +264,28 @@ def on_tick_start(proc: int, tick: int) -> bool:
         record_event("resilience.fault_drop_poll", proc=proc, tick=tick)
         return True
     return False
+
+
+def at_point(name: str, proc: int | None = None) -> None:
+    """Named-crash-window hook: SIGKILLs this process when the active plan has
+    a matching ``kill_point`` step. Subsystems call this to bracket windows
+    that tick-addressed kills cannot hit deterministically (e.g. between the
+    delivery plane's durable stage and the manifest commit). No plan = one
+    attribute read."""
+    plan = _active
+    if plan is None:
+        return
+    if proc is None:
+        proc = int(os.environ.get("PATHWAY_PROCESS_ID", "0") or 0)
+    spec = plan.take_point_kill(name, proc)
+    if spec is not None:
+        print(
+            f"pathway_tpu fault injection: SIGKILL process {proc} "
+            f"at point {name!r}",
+            file=sys.stderr,
+            flush=True,
+        )
+        os.kill(os.getpid(), signal.SIGKILL)
 
 
 def corrupt_polled(proc: int, tick: int, batches: list) -> list:

@@ -67,6 +67,89 @@ def test_debezium_parser_ops():
     assert [(e.values, e.diff) for e in dele] == [((1, "b"), -1)]
 
 
+def test_debezium_parser_read_op_and_schema_block():
+    S = pw.schema_from_types(id=int, v=str)
+    p = DebeziumMessageParser(S)
+    # op "r" is the initial-snapshot read: an insert of ``after``
+    (ev,) = p.parse(
+        RawMessage(value=json.dumps({"payload": {"op": "r", "after": {"id": 3, "v": "s"}}}))
+    )
+    assert (ev.values, ev.diff) == ((3, "s"), 1)
+    # Connect schema block on the value side unwraps transparently
+    wrapped = {
+        "schema": {"type": "struct"},
+        "payload": {"op": "c", "after": {"id": 4, "v": "w"}},
+    }
+    (ev,) = p.parse(RawMessage(value=json.dumps(wrapped)))
+    assert (ev.values, ev.diff) == ((4, "w"), 1)
+    # bare envelope without the payload wrapper also parses
+    (ev,) = p.parse(RawMessage(value=json.dumps({"op": "c", "after": {"id": 5, "v": "q"}})))
+    assert (ev.values, ev.diff) == ((5, "q"), 1)
+
+
+def test_debezium_tombstones():
+    S = pw.schema_from_types(id=int, v=str)
+    p = DebeziumMessageParser(S, tombstones=True)
+    # log-compaction tombstone: null value, pk in the message key
+    (ev,) = p.parse(RawMessage(value=None, key=json.dumps({"id": 7})))
+    assert ev.tombstone and ev.diff == -1 and ev.values[0] == 7
+    # "payload": null and empty-string values are tombstones too
+    (ev,) = p.parse(
+        RawMessage(value=json.dumps({"payload": None}), key=json.dumps({"id": 8}))
+    )
+    assert ev.tombstone and ev.values[0] == 8
+    (ev,) = p.parse(RawMessage(value="", key=json.dumps({"id": 9})))
+    assert ev.tombstone
+    # schema block on the KEY side unwraps as well
+    (ev,) = p.parse(
+        RawMessage(
+            value=None,
+            key=json.dumps({"schema": {}, "payload": {"id": 10}}),
+        )
+    )
+    assert ev.tombstone and ev.values[0] == 10
+    # keyless or non-JSON / non-dict keys cannot address a row: skipped
+    assert p.parse(RawMessage(value=None, key=None)) == []
+    assert p.parse(RawMessage(value=None, key="not json")) == []
+    assert p.parse(RawMessage(value=None, key=json.dumps([1, 2]))) == []
+    # with tombstones disabled (diff-native consumers) they are skipped
+    off = DebeziumMessageParser(S)
+    assert off.parse(RawMessage(value=None, key=json.dumps({"id": 7}))) == []
+
+
+def test_kafka_debezium_tombstone_deletes_upsert_row():
+    broker = MockKafkaBroker()
+    broker.create_topic("cdc2")
+    broker.produce(
+        "cdc2",
+        json.dumps({"payload": {"op": "c", "after": {"id": 1, "v": "a"}}}),
+        key=json.dumps({"id": 1}),
+    )
+    broker.produce(
+        "cdc2",
+        json.dumps({"payload": {"op": "c", "after": {"id": 2, "v": "b"}}}),
+        key=json.dumps({"id": 2}),
+    )
+    # Debezium delete followed by its compaction tombstone (null payload):
+    # the op:d envelope retracts row 1; the valueless tombstone event must
+    # flow through the connector without becoming a double-delete
+    broker.produce(
+        "cdc2",
+        json.dumps({"payload": {"op": "d", "before": {"id": 1, "v": "a"}}}),
+        key=json.dumps({"id": 1}),
+    )
+    broker.produce("cdc2", "null", key=json.dumps({"id": 1}))
+
+    class PkS(pw.Schema):
+        id: int = pw.column_definition(primary_key=True)
+        v: str
+
+    t = pw.io.debezium.read(broker, "cdc2", schema=PkS, mode="static")
+    cap = pw.debug._capture(t)
+    rows = sorted(dict(cap.rows).values())
+    assert rows == [(2, "b")]
+
+
 def test_formatters_roundtrip():
     cols = ["name", "qty"]
     jf = JsonLinesFormatter(cols)

@@ -1,0 +1,731 @@
+"""Exactly-once delivery plane tests (r22): ledger discipline, idempotent
+transports, writer/plane lifecycle, end-to-end kafka/postgres/fs sinks over
+operator persistence, fault-plan crash points, and the observability surfaces.
+"""
+
+import json
+import os
+import pickle
+import time as _time
+import types
+import zlib
+
+import pytest
+
+import pathway_tpu as pw
+from pathway_tpu.delivery import (
+    KAFKA_CONTROL_TOPIC,
+    PG_COMMIT_TABLE,
+    DeliveryLedger,
+    DeliveryPlane,
+    FsDeliveryTransport,
+    KafkaDeliveryTransport,
+    LedgerWriter,
+    PostgresDeliveryTransport,
+    read_committed,
+    resolve_mode,
+    stable_partition,
+)
+from pathway_tpu.internals.parse_graph import G
+from pathway_tpu.io._pg_fake import FakePostgres, FakePostgresError
+from pathway_tpu.io.kafka import MockKafkaBroker
+from pathway_tpu.persistence.backends import MemoryBackend
+
+
+def _mem_backend(root: str) -> MemoryBackend:
+    MemoryBackend.clear(root)
+    return MemoryBackend(root)
+
+
+# ---------------------------------------------------------------- ledger unit
+
+
+def test_ledger_stage_load_roundtrip():
+    b = _mem_backend("dlv1")
+    led = DeliveryLedger(b, "sink")
+    rows = led.stage(3, {0: ["a", "b", "c"], 1: ["d"]}, chunk_rows=2)
+    assert rows == 4
+    assert led.staged_epochs() == [3]
+    idx = led.index(3)
+    assert idx["rows"] == 4
+    assert idx["parts"] == {0: 2, 1: 1}  # chunk_rows=2 splits part 0 in two
+    assert led.load(3) == {0: ["a", "b", "c"], 1: ["d"]}
+    assert led.load(99) == {}
+
+
+def test_ledger_discard_publish_gc_and_durability():
+    b = _mem_backend("dlv2")
+    led = DeliveryLedger(b, "sink")
+    for e in (1, 2, 3):
+        led.stage(e, {0: [f"r{e}"]}, chunk_rows=8)
+    assert led.discard_above(1) == (2, 2)
+    assert led.staged_epochs() == [1]
+    led.mark_published(1)
+    assert led.published_epoch == 1
+    assert led.staged_epochs() == []  # published bytes are GCed
+    # the frontier is durable: a fresh handle over the same backend sees it
+    assert DeliveryLedger(b, "sink").published_epoch == 1
+
+
+def test_ledger_oldest_unpublished():
+    b = _mem_backend("dlv3")
+    led = DeliveryLedger(b, "sink")
+    assert led.oldest_unpublished_unix() is None
+    before = _time.time()
+    led.stage(5, {0: ["x"]}, chunk_rows=8)
+    assert led.oldest_unpublished_unix() >= before - 1
+    led.mark_published(5)
+    assert led.oldest_unpublished_unix() is None
+
+
+def test_safe_sink_id_sanitized():
+    b = _mem_backend("dlv4")
+    led = DeliveryLedger(b, "fs./tmp/out file.csv")
+    assert "/" not in led.sink_id and " " not in led.sink_id
+    led.stage(0, {0: ["x"]}, chunk_rows=8)
+    assert led.staged_epochs() == [0]
+
+
+# ---------------------------------------------------------------- writer unit
+
+
+class _RecordingTransport:
+    def __init__(self):
+        self.published: list[tuple[int, dict]] = []
+        self.fail = False
+
+    def publish(self, sink_id, epoch, parts):
+        if self.fail:
+            raise IOError("sink down")
+        self.published.append((epoch, parts))
+
+
+def test_writer_stage_publish_counters():
+    b = _mem_backend("dlv5")
+    t = _RecordingTransport()
+    w = LedgerWriter("s", t, chunk_rows=8)
+    assert w.bind(b) == (0, 0)
+    w.append(0, "r1")
+    w.append(1, "r2")
+    assert w.stage(0) == 2
+    assert w.depth() == 1
+    assert w.publish_up_to(0) == 2
+    assert t.published == [(0, {0: ["r1"], 1: ["r2"]})]
+    assert w.published_epoch == 0 and w.depth() == 0
+    assert w.staged_rows_total == 2 and w.published_rows_total == 2
+    assert w.published_epochs_total == 1
+    # staging nothing is a no-op (no forced epoch commit)
+    assert w.stage(1) == 0
+
+
+def test_writer_bind_discards_orphans_past_cut():
+    b = _mem_backend("dlv6")
+    pre = DeliveryLedger(b, "s")
+    pre.stage(5, {0: ["frozen"]}, chunk_rows=8)
+    pre.stage(7, {0: ["orphan1", "orphan2"]}, chunk_rows=8)
+    t = _RecordingTransport()
+    w = LedgerWriter("s", t, chunk_rows=8)
+    w.restore_sink({"staged_epoch": 5})
+    dropped_epochs, dropped_rows = w.bind(b)
+    assert (dropped_epochs, dropped_rows) == (1, 2)
+    # the frozen epoch at the cut published during bind; the orphan is gone
+    assert t.published == [(5, {0: ["frozen"]})]
+    assert w.discarded_rows_total == 2
+
+
+def test_writer_bind_refuses_published_past_cut():
+    b = _mem_backend("dlv7")
+    pre = DeliveryLedger(b, "s")
+    pre.mark_published(3)
+    w = LedgerWriter("s", _RecordingTransport(), chunk_rows=8)
+    w.restore_sink({"staged_epoch": 1})
+    with pytest.raises(RuntimeError, match="already published"):
+        w.bind(b)
+
+
+def test_writer_publish_failure_nonfatal_then_strict():
+    b = _mem_backend("dlv8")
+    t = _RecordingTransport()
+    t.fail = True
+    w = LedgerWriter("s", t, chunk_rows=8)
+    w.bind(b)
+    w.append(0, "r")
+    w.stage(0)
+    assert w.publish_up_to(0) == 0  # swallowed: retried at the next cut
+    assert w.publish_failures == 1
+    assert "sink down" in w.last_publish_error
+    with pytest.raises(RuntimeError, match="at close"):
+        w.publish_up_to(0, strict=True)
+    t.fail = False
+    assert w.publish_up_to(0) == 1
+    assert w.last_publish_error is None
+
+
+def test_writer_depth_bound_backpressure():
+    b = _mem_backend("dlv9")
+    t = _RecordingTransport()
+    t.fail = True
+    w = LedgerWriter("s", t, chunk_rows=8)
+    w.max_staged_epochs = 2
+    w.bind(b)
+    for e in (0, 1):
+        w.append(0, f"r{e}")
+        w.stage(e)
+        w.publish_up_to(e)  # fails, depth grows
+    w.append(0, "r2")
+    with pytest.raises(RuntimeError, match="PATHWAY_DELIVERY_MAX_STAGED_EPOCHS"):
+        w.stage(2)
+
+
+def test_writer_sink_state_cut_roundtrip():
+    w = LedgerWriter("s", _RecordingTransport())
+    w.staged_epoch = 11
+    state = w.sink_state()
+    w2 = LedgerWriter("s", _RecordingTransport())
+    w2.restore_sink(state)
+    assert w2._restored_cut == 11
+
+
+# ------------------------------------------------------------------- helpers
+
+
+def test_stable_partition_deterministic():
+    assert stable_partition("k1", 4) == zlib.crc32(b"k1") % 4
+    assert stable_partition(None, 4) == 0
+    assert stable_partition("anything", 1) == 0
+    # stable across calls (hash() would be process-salted)
+    assert stable_partition("abc", 16) == stable_partition("abc", 16)
+
+
+def test_resolve_mode(monkeypatch):
+    assert resolve_mode("off") == "off"
+    assert resolve_mode("exactly_once") == "exactly_once"
+    with pytest.raises(ValueError, match="delivery"):
+        resolve_mode("at_most_once")
+    monkeypatch.delenv("PATHWAY_DELIVERY", raising=False)
+    assert resolve_mode(None) == "off"
+    monkeypatch.setenv("PATHWAY_DELIVERY", "exactly_once")
+    assert resolve_mode(None) == "exactly_once"
+
+
+def test_delivery_knobs(monkeypatch):
+    from pathway_tpu.internals.config import get_pathway_config
+
+    cfg = get_pathway_config()
+    monkeypatch.delenv("PATHWAY_DELIVERY", raising=False)
+    assert cfg.delivery == "off"
+    monkeypatch.setenv("PATHWAY_DELIVERY", "bogus")
+    with pytest.raises(ValueError, match="PATHWAY_DELIVERY"):
+        cfg.delivery  # noqa: B018
+    monkeypatch.setenv("PATHWAY_DELIVERY_STAGE_ROWS", "7")
+    assert cfg.delivery_stage_rows == 7
+    monkeypatch.setenv("PATHWAY_DELIVERY_MAX_STAGED_EPOCHS", "0")
+    assert cfg.delivery_max_staged_epochs == 1  # clamped
+    monkeypatch.setenv("PATHWAY_ALERT_SINK_STALL_S", "33.5")
+    assert cfg.alert_sink_stall_s == 33.5
+    monkeypatch.delenv("PATHWAY_DELIVERY", raising=False)
+    d = cfg.to_dict()
+    for k in (
+        "delivery",
+        "delivery_stage_rows",
+        "delivery_max_staged_epochs",
+        "alert_sink_stall_s",
+    ):
+        assert k in d, k
+
+
+def test_fault_plan_kill_point_parse_roundtrip():
+    from pathway_tpu.resilience.faults import FaultPlan
+
+    plan = FaultPlan.parse("kill_point:point=delivery_staged,count=2")
+    (spec,) = plan.specs
+    assert spec.action == "kill_point" and spec.point == "delivery_staged"
+    assert plan.take_point_kill("delivery_staged", 0) is None  # pass 1 of 2
+    assert plan.take_point_kill("delivery_staged", 0) is not None  # pass 2
+    assert plan.take_point_kill("delivery_staged", 0) is None  # spent
+    env = FaultPlan.parse("kill_point:point=delivery_committed").to_env()
+    reparsed = FaultPlan.parse(env)
+    assert reparsed.specs[0].point == "delivery_committed"
+    with pytest.raises(ValueError, match="point="):
+        FaultPlan.parse("kill_point:count=1")
+
+
+# ------------------------------------------------------------ kafka transport
+
+
+def test_kafka_read_committed_semantics():
+    broker = MockKafkaBroker()
+    broker.create_topic("t", 2)
+    tr = KafkaDeliveryTransport(broker, "t")
+    tr.publish("s", 0, {0: [("k1", "v1")], 1: [("k2", "v2")]})
+    msgs, stats = read_committed(broker, "t")
+    assert sorted(msgs) == [("k1", "v1"), ("k2", "v2")]
+    assert stats["duplicates"] == 0 and stats["uncommitted"] == 0
+    assert stats["committed_epochs"] == {"s": 0}
+
+    # crash-window re-publish of the same frozen epoch: deduped by headers
+    tr.publish("s", 0, {0: [("k1", "v1")], 1: [("k2", "v2")]})
+    msgs, stats = read_committed(broker, "t")
+    assert sorted(msgs) == [("k1", "v1"), ("k2", "v2")]
+    assert stats["duplicates"] == 2
+
+    # rows staged past the last marker (epoch never committed): hidden
+    broker.produce(
+        "t",
+        "v3",
+        key="k3",
+        partition=0,
+        headers={"pw_sink": "s", "pw_epoch": "9", "pw_part": "0", "pw_seq": "0"},
+    )
+    msgs, stats = read_committed(broker, "t")
+    assert ("k3", "v3") not in msgs
+    assert stats["uncommitted"] == 1
+
+    # a plain producer sharing the topic passes straight through
+    broker.produce("t", "plainv", key="pk", partition=1)
+    msgs, stats = read_committed(broker, "t")
+    assert ("pk", "plainv") in msgs
+    assert stats["plain"] == 1
+
+
+def test_mock_broker_batch_and_headers_roundtrip(tmp_path):
+    # file-backed log: headers survive the jsonl roundtrip, fetch() keeps the
+    # legacy (key, value) tuple shape
+    broker = MockKafkaBroker(path=str(tmp_path / "log"))
+    broker.produce_batch(
+        [{"topic": "t", "partition": 0, "key": "k", "value": "v",
+          "headers": {"pw_sink": "s"}}],
+        marker={"topic": KAFKA_CONTROL_TOPIC, "partition": 0, "key": "s",
+                "value": json.dumps({"sink": "s", "epoch": 0})},
+    )
+    assert broker.fetch("t", 0, 0) == [("k", "v")]
+    (rec,) = broker.fetch_records("t", 0, 0)
+    assert rec["h"] == {"pw_sink": "s"}
+    assert broker.fetch(KAFKA_CONTROL_TOPIC, 0, 0) != []
+
+
+# ---------------------------------------------------------- postgres transport
+
+
+def _make_pg(tmp_path, ddl):
+    fake = FakePostgres(str(tmp_path / "pg.db"))
+    con = fake.connect()
+    cur = con.cursor()
+    cur.execute(ddl)
+    con.commit()
+    return fake
+
+
+def test_postgres_transport_epoch_idempotent(tmp_path):
+    fake = _make_pg(
+        tmp_path, "CREATE TABLE words (word TEXT PRIMARY KEY, total BIGINT)"
+    )
+    upsert = (
+        "INSERT INTO words (word, total) VALUES (%s, %s) "
+        "ON CONFLICT (word) DO UPDATE SET total = EXCLUDED.total"
+    )
+    delete = "DELETE FROM words WHERE word = %s"
+    tr = PostgresDeliveryTransport(
+        {"connection_factory": fake.connect}, {"u": upsert, "d": delete}
+    )
+    tr.publish("pg.words", 0, {0: [("u", ("a", 1)), ("u", ("b", 2))]})
+    tr.publish("pg.words", 1, {0: [("d", ("a",)), ("u", ("b", 5))]})
+    assert fake.dump("words", order_by=["word"]) == [("b", 5)]
+    # re-publishing a committed epoch is a whole-transaction no-op
+    tr.publish("pg.words", 1, {0: [("d", ("b",))]})
+    assert fake.dump("words", order_by=["word"]) == [("b", 5)]
+    marks = fake.dump(PG_COMMIT_TABLE, order_by=["epoch"])
+    assert marks == [("pg.words", 0), ("pg.words", 1)]
+
+
+# ---------------------------------------------------------------- fs transport
+
+
+def test_fs_transport_sidecar_idempotence(tmp_path):
+    path = str(tmp_path / "out.csv")
+    tr = FsDeliveryTransport(path, header="a,b\n")
+    tr.publish("fs", 0, {0: ["1,2\n"]})
+    tr.publish("fs", 1, {0: ["3,4\n"]})
+    with open(path) as fh:
+        content = fh.read()
+    assert content == "a,b\n1,2\n3,4\n"
+    # re-publish of an already-durable epoch: skipped whole
+    tr.publish("fs", 1, {0: ["GARBAGE\n"]})
+    with open(path) as fh:
+        assert fh.read() == content
+    # partial tail past the sidecar offset is truncated before appending
+    with open(path, "a") as fh:
+        fh.write("torn-partial-line")
+    tr.publish("fs", 2, {0: ["5,6\n"]})
+    with open(path) as fh:
+        assert fh.read() == "a,b\n1,2\n3,4\n5,6\n"
+    side = json.load(open(path + ".delivery"))
+    assert side["epoch"] == 2 and side["offset"] == os.path.getsize(path)
+
+
+# --------------------------------------------------------------- fake postgres
+
+
+def test_fake_postgres_dialect(tmp_path):
+    fake = FakePostgres(str(tmp_path / "db"))
+    con = fake.connect()
+    cur = con.cursor()
+    cur.execute("CREATE TABLE t (a TEXT, b BIGINT, PRIMARY KEY (a))")
+    cur.execute("INSERT INTO t (a, b) VALUES (%s, %s)", ("x", 1))
+    # uncommitted state visible to this connection's SELECT, not to others
+    cur.execute("SELECT * FROM t")
+    assert cur.fetchall() == [("x", 1)]
+    assert fake.dump("t") == []
+    con.commit()
+    assert fake.dump("t") == [("x", 1)]
+    # upsert updates in place
+    cur.execute(
+        "INSERT INTO t (a, b) VALUES (%s, %s) "
+        "ON CONFLICT (a) DO UPDATE SET b = EXCLUDED.b",
+        ("x", 9),
+    )
+    con.commit()
+    assert fake.dump("t") == [("x", 9)]
+    # plain insert violating the PK raises and the txn rolls back
+    cur.execute("INSERT INTO t (a, b) VALUES (%s, %s)", ("x", 2))
+    with pytest.raises(FakePostgresError, match="duplicate key"):
+        con.commit()
+    con.rollback()
+    cur.execute("DELETE FROM t WHERE a = %s", ("x",))
+    con.commit()
+    assert fake.dump("t") == []
+    # rollback discards pending ops
+    cur.execute("INSERT INTO t (a, b) VALUES (%s, %s)", ("y", 1))
+    con.rollback()
+    con.commit()
+    assert fake.dump("t") == []
+    with pytest.raises(FakePostgresError, match="does not exist"):
+        cur.execute("SELECT * FROM missing")
+
+
+# ----------------------------------------------------------------- end-to-end
+
+
+class KS(pw.Schema):
+    k: str
+    v: int
+
+
+def _operator_config(tmp_path, sub="pstate"):
+    return pw.persistence.Config(
+        backend=pw.persistence.Backend.filesystem(str(tmp_path / sub)),
+        persistence_mode="operator_persisting",
+    )
+
+
+def test_kafka_exactly_once_end_to_end(tmp_path):
+    broker = MockKafkaBroker()
+    broker.create_topic("in", 1)
+    inputs = [(f"key{i}", i) for i in range(9)]
+    for k, v in inputs:
+        broker.produce("in", json.dumps({"k": k, "v": v}))
+
+    G.clear()
+    t = pw.io.kafka.read(broker, "in", schema=KS, format="json", mode="static")
+    pw.io.kafka.write(
+        t,
+        broker,
+        "out",
+        format="json",
+        key_column="k",
+        delivery="exactly_once",
+        partitions=2,
+    )
+    pw.run(persistence_config=_operator_config(tmp_path))
+
+    assert broker.partitions("out") == 2
+    msgs, stats = read_committed(broker, "out")
+    assert stats["duplicates"] == 0 and stats["uncommitted"] == 0
+    assert "kafka.out" in stats["committed_epochs"]
+    got = sorted((json.loads(v)["k"], json.loads(v)["v"]) for _k, v in msgs)
+    assert got == sorted(inputs)
+    # message keys route by the stable key hash
+    for k, _v in msgs:
+        assert k is not None
+
+
+def test_kafka_exactly_once_restart_no_duplicates(tmp_path):
+    broker = MockKafkaBroker()
+    broker.create_topic("in", 1)
+
+    def session(n_rows):
+        G.clear()
+        t = pw.io.kafka.read(
+            broker, "in", schema=KS, format="json", mode="static", name="cdcin"
+        )
+        pw.io.kafka.write(
+            t, broker, "out", format="json", key_column="k",
+            delivery="exactly_once",
+        )
+        pw.run(persistence_config=_operator_config(tmp_path))
+
+    for i in range(5):
+        broker.produce("in", json.dumps({"k": f"a{i}", "v": i}))
+    session(5)
+    msgs1, stats1 = read_committed(broker, "out")
+    assert len(msgs1) == 5 and stats1["duplicates"] == 0
+
+    # restart over the same backend + broker with 5 more rows: the restored
+    # cut means nothing re-publishes, only the new rows land
+    for i in range(5, 10):
+        broker.produce("in", json.dumps({"k": f"a{i}", "v": i}))
+    session(10)
+    msgs2, stats2 = read_committed(broker, "out")
+    assert stats2["duplicates"] == 0 and stats2["uncommitted"] == 0
+    keys = sorted(json.loads(v)["k"] for _k, v in msgs2)
+    assert keys == sorted(f"a{i}" for i in range(10))
+    # run 1's messages are a prefix of run 2's view (frozen bytes kept)
+    assert msgs2[: len(msgs1)] == msgs1
+
+
+class WS(pw.Schema):
+    word: str
+    count: int
+
+
+def test_postgres_snapshot_exactly_once_end_to_end(tmp_path):
+    fake = _make_pg(
+        tmp_path, "CREATE TABLE words (word TEXT PRIMARY KEY, total BIGINT)"
+    )
+    settings = {"connection_factory": fake.connect}
+    # timed stream: "a" updates across ticks, so the sink sees real
+    # retract+insert pairs, exercising the diff-aware DELETE/UPSERT path
+    rows = [
+        ("a", 1, 0, 1),
+        ("b", 2, 1, 1),
+        ("a", 3, 2, 1),
+    ]
+
+    def session():
+        G.clear()
+        t = pw.debug.table_from_rows(WS, rows, is_stream=True)
+        agg = t.groupby(pw.this.word).reduce(
+            pw.this.word, total=pw.reducers.sum(pw.this.count)
+        )
+        pw.io.postgres.write_snapshot(
+            agg, settings, "words", primary_key=["word"], delivery="exactly_once"
+        )
+        pw.run(persistence_config=_operator_config(tmp_path))
+
+    session()
+    assert fake.dump("words", order_by=["word"]) == [("a", 4), ("b", 2)]
+    marks = fake.dump(PG_COMMIT_TABLE)
+    assert marks and all(m[0] == "postgres.words" for m in marks)
+
+    # deterministic restart: everything replays as the persisted prefix, the
+    # sink publishes nothing new, downstream state is untouched
+    n_marks = len(marks)
+    session()
+    assert fake.dump("words", order_by=["word"]) == [("a", 4), ("b", 2)]
+    assert len(fake.dump(PG_COMMIT_TABLE)) == n_marks
+
+
+def test_postgres_plain_append_rejects_retractions(tmp_path):
+    fake = _make_pg(
+        tmp_path,
+        "CREATE TABLE events (word TEXT, total BIGINT, time BIGINT, diff BIGINT)",
+    )
+    rows = [("a", 1, 0, 1), ("a", 5, 1, 1)]
+    G.clear()
+    t = pw.debug.table_from_rows(WS, rows, is_stream=True)
+    # the aggregate update retracts the old total — plain-append must refuse
+    agg = t.groupby(pw.this.word).reduce(
+        pw.this.word, total=pw.reducers.sum(pw.this.count)
+    )
+    pw.io.postgres.write(agg, {"connection_factory": fake.connect}, "events")
+    with pytest.raises(RuntimeError, match="write_snapshot"):
+        pw.run()
+
+
+def test_fs_exactly_once_end_to_end(tmp_path):
+    out = str(tmp_path / "out.csv")
+    rows = [("a", 1, 0, 1), ("b", 2, 1, 1), ("c", 3, 2, 1)]
+
+    def session(rs):
+        G.clear()
+        t = pw.debug.table_from_rows(WS, rs, is_stream=True)
+        pw.io.fs.write(t, out, format="csv", delivery="exactly_once")
+        pw.run(persistence_config=_operator_config(tmp_path))
+
+    session(rows)
+    import csv
+
+    with open(out) as fh:
+        content1 = fh.read()
+    got = sorted(r["word"] for r in csv.DictReader(content1.splitlines()))
+    assert got == ["a", "b", "c"]
+    side = json.load(open(out + ".delivery"))
+    assert side["offset"] == os.path.getsize(out)
+
+    # replay-only restart: file byte-identical
+    session(rows)
+    with open(out) as fh:
+        assert fh.read() == content1
+
+    # restart with a new row: the completed prefix survives, suffix appends
+    session(rows + [("d", 4, 3, 1)])
+    with open(out) as fh:
+        content3 = fh.read()
+    assert content3.startswith(content1)
+    got = sorted(r["word"] for r in csv.DictReader(content3.splitlines()))
+    assert got == ["a", "b", "c", "d"]
+
+
+def test_fs_exactly_once_rejects_sharded(tmp_path):
+    G.clear()
+    t = pw.debug.table_from_rows(WS, [("a", 1)])
+    with pytest.raises(ValueError, match="sharded"):
+        pw.io.fs.write(
+            t, str(tmp_path / "o.csv"), format="csv",
+            sharded=True, delivery="exactly_once",
+        )
+
+
+# -------------------------------------------------------------------- guards
+
+
+def test_exactly_once_requires_persistence(tmp_path):
+    broker = MockKafkaBroker()
+    G.clear()
+    t = pw.debug.table_from_rows(WS, [("a", 1)])
+    pw.io.kafka.write(t, broker, "out", format="json", delivery="exactly_once")
+    with pytest.raises(RuntimeError, match="persistence"):
+        pw.run()
+
+
+def test_exactly_once_requires_operator_mode(tmp_path):
+    broker = MockKafkaBroker()
+    G.clear()
+    t = pw.debug.table_from_rows(WS, [("a", 1)])
+    pw.io.kafka.write(t, broker, "out", format="json", delivery="exactly_once")
+    with pytest.raises(RuntimeError, match="operator_persisting"):
+        pw.run(
+            persistence_config=pw.persistence.Config(
+                backend=pw.persistence.Backend.filesystem(str(tmp_path / "p"))
+            )
+        )
+
+
+# ------------------------------------------------------------- observability
+
+
+def _bound_plane(root="dlvobs"):
+    b = _mem_backend(root)
+    t = _RecordingTransport()
+    w = LedgerWriter("obs.sink", t, chunk_rows=8)
+    plane = DeliveryPlane([w], b, next_epoch=lambda: 0)
+    plane.bind_all()
+    w.append(0, "r1")
+    plane.stage_tick()
+    plane.publish_committed()
+    return plane, w
+
+
+def test_plane_summaries_and_prometheus():
+    from pathway_tpu import delivery as delivery_mod
+
+    plane, w = _bound_plane()
+    rt = types.SimpleNamespace(persistence=types.SimpleNamespace(delivery=plane))
+    s = delivery_mod.run_summary(rt)
+    assert s["staged_rows"] == 1 and s["published_rows"] == 1
+    assert s["sinks"]["obs.sink"]["published_epoch"] == 0
+    hb = delivery_mod.heartbeat_summary(rt)
+    assert hb == {
+        "sinks": 1,
+        "depth": 0,
+        "staged": 1,
+        "published": 1,
+        "failures": 0,
+        "oldest_unpublished_unix": None,
+    }
+    lines = delivery_mod.prometheus_lines(rt)
+    assert 'pathway_delivery_staged_rows_total{sink="obs.sink"} 1' in lines
+    assert 'pathway_delivery_published_epoch{sink="obs.sink"} 0' in lines
+    # no plane bound -> no series, no summary
+    bare = types.SimpleNamespace(persistence=None)
+    assert delivery_mod.run_summary(bare) is None
+    assert delivery_mod.prometheus_lines(bare) == []
+
+
+def test_sink_commit_stall_detector(monkeypatch):
+    from pathway_tpu.internals.config import get_pathway_config
+    from pathway_tpu.observability import health as health_mod
+
+    b = _mem_backend("dlvstall")
+    t = _RecordingTransport()
+    t.fail = True
+    w = LedgerWriter("stall.sink", t, chunk_rows=8)
+    plane = DeliveryPlane([w], b, next_epoch=lambda: 0)
+    plane.bind_all()
+    w.append(0, "r")
+    plane.stage_tick()
+    plane.publish_committed()  # fails; the epoch stays staged
+    # age the staged index past the threshold
+    idx_key = w.ledger._index_key(0)
+    idx = pickle.loads(b.get(idx_key))
+    idx["staged_unix"] -= 10_000.0
+    b.put(idx_key, pickle.dumps(idx))
+
+    rt = types.SimpleNamespace(persistence=types.SimpleNamespace(delivery=plane))
+    hplane = health_mod.HealthPlane(get_pathway_config(), runtime=rt)
+    breaches = hplane._detectors()
+    stall = [x for x in breaches if x["alert"] == "sink_commit_stall"]
+    assert stall and stall[0]["fingerprint"] == "stall.sink"
+    assert "stall.sink" in stall[0]["summary"]
+
+
+def test_run_stats_include_delivery():
+    from pathway_tpu.internals.monitoring import run_stats
+
+    plane, _w = _bound_plane("dlvstats")
+    rt = types.SimpleNamespace(
+        persistence=types.SimpleNamespace(delivery=plane), scheduler=None
+    )
+    stats = run_stats(rt)
+    assert stats["delivery"]["published_rows"] == 1
+
+
+def test_heartbeat_and_cluster_delivery_rollup():
+    from pathway_tpu.observability import aggregate
+
+    plane, _w = _bound_plane("dlvroll")
+
+    class _Mon:
+        def peer_summaries(self):
+            return {
+                1: {
+                    "tick": 3,
+                    "watermark": None,
+                    "backlog_rows": 0,
+                    "delivery": {
+                        "sinks": 1,
+                        "depth": 2,
+                        "staged": 10,
+                        "published": 8,
+                        "failures": 1,
+                        "oldest_unpublished_unix": 100.0,
+                    },
+                }
+            }
+
+    rt = types.SimpleNamespace(
+        persistence=types.SimpleNamespace(delivery=plane),
+        scheduler=None,
+        hb_monitor=_Mon(),
+    )
+    local = aggregate.local_summary(rt)
+    assert local["delivery"]["published"] == 1  # rides every heartbeat
+    out = aggregate.cluster_status(rt)
+    assert out["delivery"] == {
+        "sinks": 2,
+        "depth_max": 2,
+        "staged_rows": 11,
+        "published_rows": 9,
+        "publish_failures": 1,
+        "oldest_unpublished_unix": 100.0,
+    }
