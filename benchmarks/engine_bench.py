@@ -239,7 +239,10 @@ def _small_tick_run(rpt: int, n_ticks: int) -> tuple[float, dict]:
 
 
 def _last_committed_metric(
-    metric: str, exclude: str | None = None, tail_fallback: bool = False
+    metric: str,
+    exclude: str | None = None,
+    tail_fallback: bool = False,
+    raw: bool = False,
 ):
     """(value, filename) of ``metric`` in the newest committed BENCH_r*.json
     carrying it, or None. ``exclude`` skips the file the current run is
@@ -267,7 +270,7 @@ def _last_committed_metric(
             continue
         rev = int(m.group(1))
         if best is None or rev > best[0]:
-            best = (rev, float(val), os.path.basename(path))
+            best = (rev, val if raw else float(val), os.path.basename(path))
     if best is None:
         return None
     return best[1], best[2]
@@ -392,9 +395,22 @@ def full(
     # static load's, exactly
     identical = static_rows == incr_rows
 
-    # attribution run: one extra incremental pass with the phase plane on
-    # (env, not enable(): every runtime.run re-installs the plane from env)
+    # attribution run: one extra incremental pass with the phase plane AND
+    # the r23 pod-timeline plane on (env, not enable(): every runtime.run
+    # re-installs the planes from env). The timeline plane spills a
+    # tick-granularity segment next to the bench output so a later
+    # ``pathway_tpu timeline diff`` can compare runs phase-by-phase.
+    import tempfile
+
+    tl_dir = (
+        os.path.splitext(os.path.abspath(out_path))[0] + ".timeline"
+        if out_path
+        else tempfile.mkdtemp(prefix="engine_bench_tl_")
+    )
     os.environ["PATHWAY_ENGINE_PHASES"] = "on"
+    os.environ["PATHWAY_TIMELINE"] = "on"
+    os.environ["PATHWAY_TIMELINE_STEP_MS"] = "100"
+    os.environ["PATHWAY_TIMELINE_DIR"] = tl_dir
     try:
         engine_phases.reset()
         phased = run(n, n_times)
@@ -402,6 +418,9 @@ def full(
         engine_phases.reset()
     finally:
         os.environ.pop("PATHWAY_ENGINE_PHASES", None)
+        os.environ.pop("PATHWAY_TIMELINE", None)
+        os.environ.pop("PATHWAY_TIMELINE_STEP_MS", None)
+        os.environ.pop("PATHWAY_TIMELINE_DIR", None)
         engine_phases.enable(False)
 
     static_s, incr_s = best[1], best[n_times]
@@ -434,6 +453,39 @@ def full(
         },
         "phase_run_seconds": phased["seconds"],
     }
+    try:
+        from pathway_tpu.observability.timeline import diff_summary, read_segments
+
+        results["timeline_segment_dir"] = tl_dir
+        results["timeline_segment_points"] = len(read_segments(tl_dir))
+    except Exception:
+        diff_summary = None  # plane unavailable: the gate still fires, unnamed
+
+    # name the phase that moved (ISSUE 20): diff this run's per-tick phase
+    # split against the newest committed BENCH file carrying one — the same
+    # comparison ``pathway_tpu timeline diff`` makes across spilled segments
+    prev_split = _last_committed_metric(
+        "phase_breakdown_per_tick_ms", exclude=out_path, raw=True
+    )
+    worst_phase = None
+    if diff_summary is not None and isinstance(
+        prev_split[0] if prev_split else None, dict
+    ):
+        rows = diff_summary(
+            [{f"phase_ms:{k}": v for k, v in prev_split[0].items()}],
+            [
+                {
+                    f"phase_ms:{k}": v
+                    for k, v in results["phase_breakdown_per_tick_ms"].items()
+                }
+            ],
+            prefixes=("phase_ms:",),
+        )
+        if rows:
+            worst_phase = rows[0]
+            results["worst_regressed_phase"] = worst_phase["metric"].split(":", 1)[1]
+            results["worst_regressed_phase_pct"] = worst_phase["regression_pct"]
+            results["phase_diff_baseline_file"] = prev_split[1]
 
     # spread-based noise detection (the observability_bench discipline): on a
     # host where same-config reps swing >1.6x, a 5-point pct drop is not a
@@ -457,6 +509,13 @@ def full(
                 f"engine_incremental_pct_of_static regressed: {pct} vs "
                 f"{prev_pct} in {prev_file} (allowed drop {GATE_DROP_PTS} pts)"
             )
+            if worst_phase is not None:
+                msg += (
+                    f"; worst-regressed phase: "
+                    f"{worst_phase['metric'].split(':', 1)[1]} "
+                    f"({worst_phase['regression_pct']:+.1f}% per-tick ms vs "
+                    f"{prev_split[1]})"
+                )
             if os.environ.get("BENCH_MODE") == "1" and not noisy:
                 results["gate_ok"] = False
                 print(json.dumps(results))

@@ -341,6 +341,187 @@ def trace(request_id, host, port):
         raise click.ClickException(doc.get("error", "trace request failed"))
 
 
+def _fetch_json(host: str, port: int, path: str) -> dict:
+    import json as _json
+    import urllib.request
+
+    url = f"http://{host}:{port}{path}"
+    try:
+        body = urllib.request.urlopen(url, timeout=5).read().decode()
+    except OSError as e:
+        raise click.ClickException(
+            f"cannot reach monitoring server at {host}:{port}: {e} "
+            "(is the pipeline running with with_http_server=True?)"
+        ) from e
+    return _json.loads(body)
+
+
+def _spark(values: list, width: int = 24) -> str:
+    """One-line unicode sparkline of a numeric series (newest right)."""
+    blocks = "▁▂▃▄▅▆▇█"
+    vals = [v for v in values if isinstance(v, (int, float))][-width:]
+    if not vals:
+        return "-" * 4
+    lo, hi = min(vals), max(vals)
+    span = (hi - lo) or 1.0
+    return "".join(blocks[int((v - lo) / span * (len(blocks) - 1))] for v in vals)
+
+
+def render_top(status: dict, tl: dict) -> str:
+    """One ``pathway_tpu top`` frame from a /status + /timeline pair (pure —
+    the live loop and the tests share it)."""
+
+    def series(metric: str) -> list:
+        return [p.get(metric) for p in tl.get("points") or () if p.get(metric) is not None]
+
+    def last(metric: str, default=0):
+        s = series(metric)
+        return s[-1] if s else default
+
+    lines = []
+    procs = tl.get("procs") or []
+    lines.append(
+        f"pathway_tpu top — proc {tl.get('proc')} of {len(procs) or 1} "
+        f"reporting ({', '.join(procs)})"
+    )
+    lines.append(
+        f"  qps {last('serve_qps'):>8.1f} {_spark(series('serve_qps'))}   "
+        f"tick_rate {last('tick_rate'):>7.1f} {_spark(series('tick_rate'))}"
+    )
+    lines.append(
+        f"  backlog {last('backlog_rows'):>6} {_spark(series('backlog_rows'))}   "
+        f"wm_lag_s {last('watermark_lag_s', 0.0):>7.2f} "
+        f"{_spark(series('watermark_lag_s'))}"
+    )
+    lines.append(
+        f"  pressure {last('flow_pressure', 0.0):>5.2f} "
+        f"{_spark(series('flow_pressure'))}   "
+        f"shed/s {last('serve_shed_per_s', 0.0):>6.1f}   "
+        f"timeouts/s {last('serve_timeouts_per_s', 0.0):>5.1f}"
+    )
+    metrics = tl.get("metrics") or []
+    stages = sorted(m for m in metrics if m.startswith("stage_p99_s:"))
+    if stages:
+        lines.append("  p99 by stage:")
+        for m in stages[:8]:
+            lines.append(
+                f"    {m.split(':', 1)[1]:<28} {last(m, 0.0) * 1e3:>8.1f} ms "
+                f"{_spark(series(m))}"
+            )
+    phases = sorted(m for m in metrics if m.startswith("phase_ms:"))
+    if phases:
+        split = ", ".join(
+            f"{m.split(':', 1)[1]}={last(m, 0.0):.0f}ms" for m in phases[:8]
+        )
+        lines.append(f"  tick split: {split}")
+    health = status.get("health") or {}
+    doors = (health.get("doors") or {}) if isinstance(health, dict) else {}
+    alerts = health.get("alerts") if isinstance(health, dict) else None
+    active = (alerts or {}).get("active") if isinstance(alerts, dict) else None
+    state = health.get("door") or health.get("state")
+    lines.append(
+        f"  doors: {doors or state or 'n/a'}   "
+        f"alerts: {[a.get('alert') for a in active] if active else 'none'}"
+    )
+    top = (status.get("bottleneck") or {}).get("top")
+    if top:
+        lines.append(
+            f"  bound by: {top.get('cause')} (score {top.get('score')}) — "
+            f"{top.get('verdict')}"
+        )
+        lines.append(f"  knob: {top.get('knob')}")
+    else:
+        lines.append("  bound by: (no bottleneck — idle or warming up)")
+    return "\n".join(lines)
+
+
+@cli.command()
+@click.option("--host", type=str, default="127.0.0.1", help="monitoring server host")
+@click.option(
+    "--port",
+    type=int,
+    default=None,
+    help="monitoring server port (default PATHWAY_MONITORING_HTTP_PORT, 20000)",
+)
+@click.option("--proc", type=str, default=None, help="process id, or 'pod' for the merged rollup")
+@click.option("--refresh", type=float, default=1.0, help="seconds between frames")
+@click.option("--once", is_flag=True, default=False, help="print one frame and exit (no ANSI redraw)")
+def top(host, port, proc, refresh, once):
+    """Live terminal view of a RUNNING pipeline: qps, p99 by stage, watermark
+    lag, backlog, pod pressure, per-phase tick split, doors/alerts and the
+    current bottleneck verdict — read purely from the monitoring server's
+    ``/timeline`` + ``/status`` endpoints."""
+    import time as _t
+
+    if port is None:
+        port = int(os.environ.get("PATHWAY_MONITORING_HTTP_PORT", "20000"))
+    qs = f"?proc={proc}" if proc else ""
+    prev_lines = 0
+    while True:
+        status = _fetch_json(host, port, "/status")
+        tl = _fetch_json(host, port, f"/timeline{qs}")
+        if not tl.get("enabled", False):
+            raise click.ClickException(
+                "timeline plane is off on the target (PATHWAY_TIMELINE=off)"
+            )
+        frame = render_top(status, tl)
+        if once:
+            click.echo(frame)
+            return
+        if prev_lines:
+            # redraw in place: cursor up + clear to end (LiveDashboard idiom)
+            sys.stdout.write(f"\x1b[{prev_lines}F\x1b[J")
+        sys.stdout.write(frame + "\n")
+        sys.stdout.flush()
+        prev_lines = frame.count("\n") + 1
+        _t.sleep(max(0.1, refresh))
+
+
+@cli.group()
+def timeline() -> None:
+    """Inspect spilled timeline segment directories."""
+
+
+@timeline.command("diff")
+@click.argument("dir_a", type=click.Path(exists=True, file_okay=False))
+@click.argument("dir_b", type=click.Path(exists=True, file_okay=False))
+@click.option(
+    "--prefix",
+    "prefixes",
+    multiple=True,
+    default=("phase_ms:", "stage_p99_s:"),
+    show_default=True,
+    help="metric prefixes to compare (repeatable)",
+)
+@click.option("--limit", type=int, default=12, help="rows to print")
+def timeline_diff(dir_a, dir_b, prefixes, limit):
+    """Cross-run comparison of two timeline segment directories: per-phase /
+    per-stage mean cost in run A vs run B, worst regression first — names the
+    PHASE that regressed, not just the number. Exits non-zero when B has no
+    comparable series."""
+    from pathway_tpu.observability.timeline import diff_summary, read_segments
+
+    points_a = read_segments(dir_a)
+    points_b = read_segments(dir_b)
+    rows = diff_summary(points_a, points_b, prefixes=tuple(prefixes))
+    if not rows:
+        raise click.ClickException(
+            f"no comparable series under {prefixes} in both directories "
+            f"({len(points_a)} vs {len(points_b)} points read)"
+        )
+    click.echo(f"{'metric':<40} {'A':>12} {'B':>12} {'Δ%':>8}")
+    for r in rows[: max(1, limit)]:
+        click.echo(
+            f"{r['metric']:<40} {r['a']:>12.4f} {r['b']:>12.4f} "
+            f"{r['regression_pct']:>+8.1f}"
+        )
+    worst = rows[0]
+    click.echo(
+        f"worst regression: {worst['metric']} "
+        f"({worst['regression_pct']:+.1f}% vs run A)"
+    )
+
+
 @cli.command(context_settings={"ignore_unknown_options": True})
 @click.option("--record-path", type=str, default="./record", help="recorded persistence root")
 @click.option(

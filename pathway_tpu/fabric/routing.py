@@ -128,6 +128,7 @@ class FabricPlane:
                 # the engine's keyed exchange placed on it
                 ir.replica.self_src = self.pid
         self.node.req_handlers["serve"] = self._handle_serve
+        self.node.req_handlers["canary"] = self._handle_canary
         self.node.req_handlers["table_lookup"] = self._handle_table_lookup
         self.node.req_handlers["replica_snapshot"] = self._handle_replica_snapshot
         self.node.req_handlers["index_snapshot"] = self._handle_index_snapshot
@@ -668,6 +669,22 @@ class FabricPlane:
         return handler
 
     # ------------------------------------------------------------ owner serving
+    def _handle_canary(self, payload: dict, reply) -> None:
+        """Health-plane link canary (r23): a tiny echo over the real request
+        transport — no engine work, no user-facing counters — so the prober
+        measures exactly the path real forwards take."""
+        from pathway_tpu.observability import health as _health
+
+        plane = _health.current()
+        reply(
+            {
+                "ok": True,
+                "pid": self.pid,
+                "state": plane.door_state() if plane is not None else None,
+                "from": payload.get("from"),
+            }
+        )
+
     def _handle_serve(self, payload: dict, reply) -> None:
         rs = self._route_states.get(payload.get("route"))
         loop = self._loop

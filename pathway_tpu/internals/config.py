@@ -927,6 +927,19 @@ class PathwayConfig:
         return max(0.0, _env_float("PATHWAY_SLO_BURN_SLOW", 2.0))
 
     @property
+    def slo_burn_ticket_fast(self) -> float:
+        """Ticket-severity rung of the burn-rate ladder (fast window): a
+        breach burning past this but under ``PATHWAY_SLO_BURN_FAST`` files a
+        ``ticket`` alert instead of a ``page`` (SRE-workbook multi-window
+        multi-burn ladder; 6 ≈ budget gone in ~5 days)."""
+        return max(0.0, _env_float("PATHWAY_SLO_BURN_TICKET_FAST", 6.0))
+
+    @property
+    def slo_burn_ticket_slow(self) -> float:
+        """Ticket-severity rung of the burn-rate ladder (slow window)."""
+        return max(0.0, _env_float("PATHWAY_SLO_BURN_TICKET_SLOW", 1.0))
+
+    @property
     def canary_interval_ms(self) -> int:
         """Synthetic canary probe interval per door route (0 disables
         canaries; readiness and detectors stay live)."""
@@ -1008,6 +1021,51 @@ class PathwayConfig:
         older than this many seconds raises ``sink_commit_stall`` (the sink's
         transport keeps failing and output is piling up in the ledger)."""
         return max(1.0, _env_float("PATHWAY_ALERT_SINK_STALL_S", 120.0))
+
+    # ---- pod timeline & bottleneck plane (observability) --------------------
+    @property
+    def timeline(self) -> str:
+        """Pod timeline plane (``observability/timeline.py``): ``on``
+        (default) samples every registered gauge/counter delta and histogram
+        positional delta on a fixed cadence into bounded in-memory rings,
+        piggybacks compressed series summaries on heartbeats so the
+        coordinator holds a merged pod timeline, and feeds the bottleneck
+        attributor. ``off`` constructs no plane — one flag read on the hot
+        path, history and /timeline simply absent."""
+        raw = os.environ.get("PATHWAY_TIMELINE", "on").strip().lower()
+        if raw in ("", "1", "true", "yes", "on"):
+            return "on"
+        if raw in ("0", "false", "no", "off"):
+            return "off"
+        raise ValueError(f"PATHWAY_TIMELINE must be off/on, got {raw!r}")
+
+    @property
+    def timeline_window_s(self) -> float:
+        """In-memory timeline history retained per process (seconds); older
+        points fall off the ring (spilled segment files keep going until
+        rotation)."""
+        return max(10.0, _env_float("PATHWAY_TIMELINE_WINDOW_S", 600.0))
+
+    @property
+    def timeline_step_ms(self) -> int:
+        """Timeline sampling cadence (milliseconds between ticks of the
+        recorder — each tick captures one delta sample of every probe)."""
+        return max(100, _env_int("PATHWAY_TIMELINE_STEP_MS", 1000))
+
+    @property
+    def timeline_dir(self) -> str | None:
+        """Timeline segment spill directory: each process appends its sampled
+        points as rotating OTLP-metrics-JSON lines (r8 file-sink discipline)
+        so the history survives a crash alongside the flight recorder. Unset
+        = in-memory rings only."""
+        return os.environ.get("PATHWAY_TIMELINE_DIR") or None
+
+    @property
+    def timeline_rotate_mb(self) -> float:
+        """Timeline segment rotation bound (MiB): past this size the live
+        segment is renamed to ``.1`` (one rotation generation kept, matching
+        the trace file sink)."""
+        return max(0.05, _env_float("PATHWAY_TIMELINE_ROTATE_MB", 32.0))
 
     # ---- exactly-once delivery (r22) ----------------------------------------
     @property
@@ -1116,6 +1174,8 @@ class PathwayConfig:
                 "slo_slow_window_s",
                 "slo_burn_fast",
                 "slo_burn_slow",
+                "slo_burn_ticket_fast",
+                "slo_burn_ticket_slow",
                 "canary_interval_ms",
                 "canary_timeout_ms",
                 "incident_dir",
@@ -1128,6 +1188,11 @@ class PathwayConfig:
                 "alert_thrash_decisions",
                 "alert_heartbeat_flaps",
                 "alert_sink_stall_s",
+                "timeline",
+                "timeline_window_s",
+                "timeline_step_ms",
+                "timeline_dir",
+                "timeline_rotate_mb",
                 "delivery",
                 "delivery_stage_rows",
                 "delivery_max_staged_epochs",

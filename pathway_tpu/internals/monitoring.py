@@ -205,6 +205,17 @@ def run_stats(runtime) -> dict[str, Any]:
     health = _health.status(runtime)
     if health is not None:
         stats["health"] = health
+    # pod timeline plane (PATHWAY_TIMELINE): ring occupancy + the ranked
+    # bottleneck verdict (the series themselves are served by /timeline)
+    from pathway_tpu.observability import bottleneck as _bottleneck
+    from pathway_tpu.observability import timeline as _timeline
+
+    tplane = _timeline.current()
+    if tplane is not None:
+        stats["timeline"] = tplane.status_summary()
+        verdict = _bottleneck.status(runtime)
+        if verdict is not None:
+            stats["bottleneck"] = verdict
     # embedding memo counters (exact hits/misses/evictions + the pod-wide
     # shared tier) — sys.modules gate: no xpacks import unless the pipeline
     # already made one
@@ -369,6 +380,21 @@ def prometheus_text(runtime) -> str:
     from pathway_tpu.observability import health as _health
 
     lines.extend(_health.prometheus_lines(runtime))
+    # ---- pod timeline plane (recorder counters + bottleneck verdict) --------
+    from pathway_tpu.observability import timeline as _timeline
+
+    tplane = _timeline.current()
+    if tplane is not None:
+        lines.append("# HELP pathway_timeline_samples_total Timeline recorder steps taken")
+        lines.append("# TYPE pathway_timeline_samples_total counter")
+        lines.append(f"pathway_timeline_samples_total {tplane.samples_total}")
+        top = (tplane.bottleneck or {}).get("top")
+        if top is not None:
+            lines.append("# HELP pathway_bottleneck_score Score of the current top throughput-bound-by verdict")
+            lines.append("# TYPE pathway_bottleneck_score gauge")
+            lines.append(
+                f'pathway_bottleneck_score{{{_fmt_label(cause=top["cause"])}}} {top["score"]}'
+            )
     # ---- exactly-once delivery plane (staged/published/uncommitted) ---------
     from pathway_tpu import delivery as _delivery_mod
 
@@ -471,6 +497,21 @@ def _trace_payload(query: str) -> bytes:
             "next": next_seq,
         }
     return json.dumps(doc).encode()
+
+
+def _timeline_payload(query: str) -> bytes:
+    """``/timeline?metric=&since=&step=&proc=`` body: the timeline plane's
+    cursor response (``proc=pod`` = merged pod rollup on the coordinator,
+    ``proc=<pid>`` = that process's heartbeat-shipped ring, default = this
+    process). ``{"enabled": false}`` with the plane off."""
+    from urllib.parse import parse_qs
+
+    from pathway_tpu.observability import timeline as _timeline
+
+    plane = _timeline.current()
+    if plane is None:
+        return json.dumps({"enabled": False, "points": [], "next": None}).encode()
+    return json.dumps(plane.payload(parse_qs(query))).encode()
 
 
 def _scale_payload(runtime, query: str) -> bytes:
@@ -639,6 +680,9 @@ class MonitoringHttpServer:
                     ctype = "application/json"
                 elif path.rstrip("/") == "/scale":
                     body = _scale_payload(rt, query)
+                    ctype = "application/json"
+                elif path.rstrip("/") == "/timeline":
+                    body = _timeline_payload(query)
                     ctype = "application/json"
                 else:
                     self.send_response(404)

@@ -28,6 +28,7 @@ from pathway_tpu.observability import (
     aggregate,
     alerts,
     audit,
+    bottleneck,
     device,
     engine_phases,
     health,
@@ -35,6 +36,7 @@ from pathway_tpu.observability import (
     metrics,
     requests,
     spans,
+    timeline,
 )
 from pathway_tpu.observability.metrics import (
     BUCKET_BOUNDS_S,
@@ -96,6 +98,10 @@ def install_from_env(runtime=None) -> Tracer | None:
     # pod health & SLO plane (door state machine, canaries, burn-rate alerts,
     # incident bundles) — on by default; off installs nothing
     health.install_from_env(runtime)
+    # pod timeline plane (tick-granularity history rings, segment spill,
+    # bottleneck attribution) — on by default; off constructs no plane. After
+    # health so the recorder can sample canary/alert state from step one.
+    timeline.install_from_env(runtime)
     if _tracer is not None:
         try:
             _tracer.close(emit_root=False)
@@ -124,6 +130,7 @@ def shutdown() -> None:
     """Close the live tracer (flush + root span + file sink). Never raises —
     runs in ``finally`` blocks next to connector/server teardown."""
     global _tracer
+    timeline.shutdown()
     health.shutdown()
     device.shutdown()
     audit.shutdown()
@@ -147,6 +154,7 @@ __all__ = [
     "alerts",
     "audit",
     "backlog_gauges",
+    "bottleneck",
     "current",
     "derive_trace_id",
     "device",
@@ -161,4 +169,5 @@ __all__ = [
     "run_trace_id",
     "shutdown",
     "spans",
+    "timeline",
 ]

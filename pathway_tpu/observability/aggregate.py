@@ -103,6 +103,13 @@ def local_summary(runtime) -> dict[str, Any]:
     hb = _health.heartbeat_summary()
     if hb is not None:
         summary["health"] = hb
+    # timeline plane: the last few derived points ride the heartbeat so the
+    # coordinator holds a merged pod timeline (dedup on t — resends are free)
+    from pathway_tpu.observability import timeline as _timeline
+
+    tplane = _timeline.current()
+    if tplane is not None:
+        summary["timeline"] = tplane.heartbeat_summary()
     # exactly-once delivery plane: staged/published totals, uncommitted-epoch
     # depth and the oldest unpublished stage time ride the heartbeat so the
     # coordinator sees a stalling sink on any process (only process 0 binds
@@ -230,6 +237,18 @@ def cluster_status(runtime) -> dict[str, Any] | None:
             "active_alerts": sorted(active_alerts),
             "alerts_fired": fired,
             "canary": canary,
+        }
+    # timeline rollup: who is reporting history and how fresh — the merged
+    # series itself is served by /timeline (too big for every /status)
+    tls = {
+        pid: p.get("timeline") for pid, p in processes.items() if p.get("timeline")
+    }
+    if tls:
+        last = [t.get("last_t") for t in tls.values() if t.get("last_t") is not None]
+        out["timeline"] = {
+            "reporting": sorted(tls),
+            "samples": sum(t.get("samples") or 0 for t in tls.values()),
+            "last_t": max(last) if last else None,
         }
     # delivery rollup: pod-wide staged/published totals, the deepest
     # uncommitted-epoch backlog and the oldest unpublished stage time

@@ -35,6 +35,10 @@ def _sanitize(s: str) -> str:
     return re.sub(r"[^A-Za-z0-9._-]+", "_", s)[:80] or "none"
 
 
+#: burn-rate ladder order: a refresh may escalate severity, never demote it
+_SEVERITY_RANK = {"info": 0, "warn": 1, "ticket": 2, "page": 3}
+
+
 # ------------------------------------------------------------------- sinks
 
 
@@ -170,6 +174,9 @@ class AlertRegistry:
         self.bundles_written = 0
         self.bundle_paths: list[str] = []
         self.sinks: list[NotificationSink] = []
+        #: recent activations as compact fragments — ride the heartbeat so
+        #: the coordinator can merge one POD bundle per activation
+        self.fragments: deque = deque(maxlen=32)
 
     # ------------------------------------------------------------ lifecycle
     @staticmethod
@@ -206,6 +213,13 @@ class AlertRegistry:
                 ent["last_seen_unix"] = round(now, 3)
                 if probable_stage and not ent.get("probable_stage"):
                     ent["probable_stage"] = probable_stage
+                # ladder escalation: a ticket-severity burn crossing the page
+                # thresholds upgrades in place (never downgrades — the page
+                # stays a page until the breach resolves)
+                if _SEVERITY_RANK.get(severity, 0) > _SEVERITY_RANK.get(
+                    ent.get("severity", "warn"), 0
+                ):
+                    ent["severity"] = severity
                 return ent
             ent = {
                 "alert": name,
@@ -246,6 +260,18 @@ class AlertRegistry:
             except Exception:
                 pass  # delivery failures are counted, never propagate
         self._capture_bundle(ent, runtime)
+        with self._lock:
+            self.fragments.append(
+                {
+                    "alert": name,
+                    "fingerprint": fingerprint,
+                    "severity": ent.get("severity", severity),
+                    "summary": summary,
+                    "fired_unix": ent["fired_unix"],
+                    "bundle": ent.get("bundle"),
+                    "process_id": self.cfg.process_id,
+                }
+            )
         return ent
 
     def resolve(self, name: str, fingerprint: str = "") -> bool:
@@ -317,7 +343,8 @@ class AlertRegistry:
                 f"{n}:{fp}" if fp else n for (n, fp) in self.active
             )
             fired = sum(self.fired_total.values())
-        return {"active": active, "fired": fired}
+            fragments = list(self.fragments)
+        return {"active": active, "fired": fired, "fragments": fragments}
 
     def prometheus_lines(self) -> list[str]:
         from pathway_tpu.internals.monitoring import escape_label_value
@@ -426,15 +453,113 @@ def write_incident_bundle(alert: dict, runtime: Any, out_dir: str) -> str | None
         doc["serving"] = _srv.serving_status(runtime)
     except Exception:
         pass
+    # r23 timeline plane: the bundle captures the LEAD-UP, not just the
+    # moment — the last minutes of derived points + the ranked bottleneck
+    # verdict at capture time
+    try:
+        from pathway_tpu.observability import timeline as _timeline
+
+        tplane = _timeline.current()
+        if tplane is not None:
+            doc["timeline_window"] = tplane.recent_points()
+            doc["bottleneck"] = tplane.bottleneck
+    except Exception:
+        pass
     path = os.path.join(
         out_dir,
         f"incident-{_sanitize(alert['alert'])}-"
         f"{_sanitize(alert.get('fingerprint') or 'pod')}-"
+        f"{_sanitize(alert.get('severity') or 'warn')}-"
         f"p{cfg.process_id}-{_time.time_ns()}.json",
     )
     with open(path, "w", encoding="utf-8") as fh:
         json.dump(doc, fh, default=str)
     return path
+
+
+# ------------------------------------------------------- pod bundle merging
+
+#: (alert, fingerprint, activation-second) keys already merged into a pod
+#: bundle — one bundle per pod per activation however many evals see it
+_pod_bundled: set[tuple] = set()
+
+
+def merge_pod_bundles(runtime, registry: AlertRegistry | None) -> list[str]:
+    """Coordinator-side satellite: fold per-process bundle FRAGMENTS (riding
+    the heartbeat health rollup) into one pod-level incident bundle per
+    activation, with the merged pod timeline window attached. Dedupes across
+    evaluator sweeps; returns the paths written this call."""
+    if registry is None:
+        return []
+    out_dir = registry.cfg.incident_dir
+    if not out_dir:
+        return []
+    fragments: list[dict] = []
+    with registry._lock:
+        fragments.extend(dict(f) for f in registry.fragments)
+    monitor = getattr(runtime, "hb_monitor", None)
+    if monitor is not None and hasattr(monitor, "peer_summaries"):
+        for pid, summary in monitor.peer_summaries().items():
+            h = (summary or {}).get("health") or {}
+            for f in h.get("fragments") or ():
+                if isinstance(f, dict):
+                    fragments.append(dict(f))
+    if not fragments:
+        return []
+    # one activation = one (alert, fingerprint) burst; processes fire within
+    # an eval cadence of each other, so second granularity separates bursts
+    groups: dict[tuple, list[dict]] = {}
+    for f in fragments:
+        key = (f.get("alert") or "", f.get("fingerprint") or "")
+        groups.setdefault(key, []).append(f)
+    written: list[str] = []
+    for (name, fp), frs in sorted(groups.items()):
+        first = min(f.get("fired_unix") or 0 for f in frs)
+        dedupe = (name, fp, int(first))
+        if dedupe in _pod_bundled:
+            continue
+        _pod_bundled.add(dedupe)
+        severity = max(
+            (f.get("severity") or "warn" for f in frs),
+            key=lambda s: _SEVERITY_RANK.get(s, 0),
+        )
+        doc: dict[str, Any] = {
+            "kind": "pathway_pod_incident_bundle",
+            "captured_unix": round(_time.time(), 3),
+            "alert": name,
+            "fingerprint": fp,
+            "severity": severity,
+            "first_fired_unix": round(first, 3),
+            "processes": sorted({f.get("process_id") for f in frs}),
+            "fragments": sorted(
+                frs, key=lambda f: (f.get("process_id") or 0, f.get("fired_unix") or 0)
+            ),
+        }
+        try:
+            from pathway_tpu.observability import timeline as _timeline
+
+            tplane = _timeline.current()
+            if tplane is not None:
+                doc["pod_timeline_window"] = tplane.pod_points(
+                    since=first - 120.0
+                )
+                doc["bottleneck"] = tplane.bottleneck
+        except Exception:
+            pass
+        os.makedirs(out_dir, exist_ok=True)
+        path = os.path.join(
+            out_dir,
+            f"pod-incident-{_sanitize(name)}-{_sanitize(fp or 'pod')}-"
+            f"{_sanitize(severity)}-{_time.time_ns()}.json",
+        )
+        try:
+            with open(path, "w", encoding="utf-8") as fh:
+                json.dump(doc, fh, default=str)
+        except OSError:
+            continue
+        written.append(path)
+        record_event("health.pod_incident_bundle", alert=name, path=path)
+    return written
 
 
 # ----------------------------------------------------------- run lifecycle
@@ -452,6 +577,7 @@ def install_from_env(runtime: Any = None) -> AlertRegistry | None:
     from pathway_tpu.internals.config import get_pathway_config
 
     cfg = get_pathway_config()
+    _pod_bundled.clear()
     if cfg.health != "on":
         _registry = None
         return None

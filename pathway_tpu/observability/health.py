@@ -258,6 +258,44 @@ class HealthPlane:
     def _probe_once(self) -> None:
         for host, port, route, method in self._probe_targets():
             self.probe_route(host, port, route, method)
+        self._probe_fabric_links()
+
+    def _probe_fabric_links(self) -> None:
+        """Synthetic self-probe per fabric peer link on the canary cadence
+        (r23): a tiny ``canary`` request over the SAME transport real
+        forwards use, recorded under the pseudo-route ``fabric:p<peer>`` so
+        internal transport rot feeds the availability SLO and the
+        heartbeat-flap detector instead of staying invisible until a real
+        forward fails."""
+        from pathway_tpu import fabric as _fabric
+
+        plane = _fabric.current()
+        if plane is None or getattr(plane, "node", None) is None:
+            return
+        if self.runtime is not None and plane.runtime is not self.runtime:
+            return
+        for peer in range(plane.n_proc):
+            if peer == plane.pid:
+                continue
+            route = f"fabric:p{peer}"
+            t0 = _time.monotonic()
+            ok = False
+            try:
+                reply = plane.node.call(
+                    peer, "canary", {"from": plane.pid},
+                    timeout=self.canary_timeout_s,
+                )
+                ok = bool(reply) and reply.get("ok") is True
+            except Exception:
+                ok = False
+            took = _time.monotonic() - t0
+            with self._lock:
+                self.canary_total[route] = self.canary_total.get(route, 0) + 1
+                if not ok:
+                    self.canary_failed[route] = (
+                        self.canary_failed.get(route, 0) + 1
+                    )
+                self.canary_last_s[route] = round(took, 6)
 
     def probe_route(self, host: str, port: int, route: str, method: str) -> bool:
         """One synthetic canary request against a local door. Canaries carry
@@ -460,19 +498,33 @@ class HealthPlane:
             self.budget_remaining[key] = round(max(0.0, 1.0 - b["slow"]), 3)
         breaches: list[dict] = []
         for key, b in self.burn.items():
-            if (
+            # multi-window burn-rate LADDER (r23): the page rung is the
+            # SRE-workbook pair; the ticket rung catches a slower sustained
+            # burn worth a work item, not a wake-up. Both need BOTH windows.
+            page = (
                 b["fast"] >= self.cfg.slo_burn_fast
                 and b["slow"] >= self.cfg.slo_burn_slow
-            ):
+            )
+            ticket = (
+                b["fast"] >= self.cfg.slo_burn_ticket_fast
+                and b["slow"] >= self.cfg.slo_burn_ticket_slow
+            )
+            if page or ticket:
+                severity = "page" if page else "ticket"
+                thresholds = (
+                    f"{self.cfg.slo_burn_fast}/{self.cfg.slo_burn_slow}"
+                    if page
+                    else f"{self.cfg.slo_burn_ticket_fast}/{self.cfg.slo_burn_ticket_slow}"
+                )
                 slo, _, route = key.partition(":")
                 breaches.append(
                     {
                         "alert": f"slo_{slo}_burn",
                         "fingerprint": route,
-                        "severity": "page",
+                        "severity": severity,
                         "summary": (
                             f"{key} burn rate fast={b['fast']} slow={b['slow']} "
-                            f"(thresholds {self.cfg.slo_burn_fast}/{self.cfg.slo_burn_slow})"
+                            f"({severity} thresholds {thresholds})"
                         ),
                         "labels": {"window_fast_s": self.cfg.slo_fast_window_s},
                         "probable_stage": self._probable_stage(),
@@ -482,6 +534,16 @@ class HealthPlane:
         self.evals_total += 1
         if self.registry is not None:
             self.registry.sync(breaches, self.runtime)
+            # coordinator-side pod bundles (r23): fold per-process fragments
+            # (riding the heartbeat health rollup) into ONE bundle per pod
+            # per activation, pod timeline window attached
+            if self.cfg.process_id == 0:
+                from pathway_tpu.observability import alerts as _alerts
+
+                try:
+                    _alerts.merge_pod_bundles(self.runtime, self.registry)
+                except Exception:
+                    pass
         return breaches
 
     def _probable_stage(self) -> str | None:
@@ -566,13 +628,29 @@ class HealthPlane:
                                 ),
                             }
                         )
-                # heartbeat flap: misses accumulating inside the window
+                # heartbeat flap: misses accumulating inside the window —
+                # failed fabric link canaries count too (r23): a peer whose
+                # transport is rotting flaps the same way one whose
+                # heartbeats are lost does
                 flaps = newest["hb_misses"] - base.get("hb_misses", 0)
-                if flaps >= cfg.alert_heartbeat_flaps:
+                link_failed = 0
+                for route, (_total, failed) in newest.get("canary", {}).items():
+                    if route.startswith("fabric:"):
+                        _bt, bf = base.get("canary", {}).get(route, (0, 0))
+                        link_failed += max(0, failed - bf)
+                if flaps + link_failed >= cfg.alert_heartbeat_flaps:
                     breaches.append(
                         {
                             "alert": "heartbeat_flap",
-                            "summary": f"{flaps} heartbeat misses in the fast window",
+                            "summary": (
+                                f"{flaps} heartbeat misses"
+                                + (
+                                    f" + {link_failed} fabric link canary failures"
+                                    if link_failed
+                                    else ""
+                                )
+                                + " in the fast window"
+                            ),
                         }
                     )
         except Exception:

@@ -436,3 +436,80 @@ def test_health_metric_line_shapes(monkeypatch):
         in body
     )
     assert 'pathway_alerts_fired_total{alert="slo_latency_burn"} 1' in body
+
+
+def test_timeline_segment_line_is_valid_otlp_metrics(tmp_path):
+    """Unit (r23): every line the timeline segment sink spills is a complete
+    OTLP-metrics-JSON document — resource attrs carry the process identity,
+    the scope names the recorder, and every series is a gauge whose data
+    points use the string-nanos/double encoding."""
+    from pathway_tpu.observability import timeline as timeline_mod
+
+    path = str(tmp_path / "timeline-p0.jsonl")
+    sink = timeline_mod.TimelineSegmentSink(path, 7, rotate_bytes=1 << 20)
+    sink.write({"t": 1234.5, "tick": 3, "serve_qps": 10.0,
+                "stage_p99_s:sweep/q": 0.4})
+    sink.close()
+    with open(path, encoding="utf-8") as fh:
+        (line,) = [l for l in fh.read().splitlines() if l.strip()]
+    doc = json.loads(line)
+    assert set(doc) == {"resourceMetrics"}
+    (rm,) = doc["resourceMetrics"]
+    attrs = {a["key"]: a["value"] for a in rm["resource"]["attributes"]}
+    assert attrs["service.name"] == {"stringValue": "pathway_tpu"}
+    assert attrs["pathway.process_id"] == {"intValue": "7"}
+    assert set(attrs["process.pid"]) == {"intValue"}
+    for a in rm["resource"]["attributes"]:
+        (vk,) = a["value"].keys()
+        assert vk in _VALUE_KEYS, a
+    (sm,) = rm["scopeMetrics"]
+    assert sm["scope"] == {"name": "pathway_tpu.timeline", "version": "1"}
+    names = set()
+    for metric in sm["metrics"]:
+        names.add(metric["name"])
+        assert set(metric) == {"name", "gauge"}
+        (dp,) = metric["gauge"]["dataPoints"]
+        assert set(dp) == {"timeUnixNano", "asDouble"}
+        assert isinstance(dp["timeUnixNano"], str)  # u64 nanos ride as string
+        assert int(dp["timeUnixNano"]) == 1234500000000
+        assert isinstance(dp["asDouble"], float)
+    assert names == {"tick", "serve_qps", "stage_p99_s:sweep/q"}
+    # and the reader round-trips the same point back
+    (pt,) = timeline_mod.read_segments(str(tmp_path))
+    assert pt["serve_qps"] == 10.0 and pt["t"] == 1234.5
+
+
+def test_bottleneck_top_event_shape(monkeypatch):
+    """Unit (r23): the attributor's ``bottleneck/top`` trace event is a valid
+    zero-duration span carrying cause/verdict/knob/score attrs, emitted only
+    when the ranked top cause CHANGES."""
+    from pathway_tpu.internals.config import get_pathway_config
+    from pathway_tpu.observability import timeline as timeline_mod
+
+    monkeypatch.setenv("PATHWAY_TRACE", "on")
+    monkeypatch.setenv("PATHWAY_TRACE_SAMPLE", "1.0")
+    obs.install_from_env(None)
+    try:
+        tracer = obs.current()
+        assert tracer is not None
+        plane = timeline_mod.TimelinePlane(get_pathway_config(), None)
+        plane.bottleneck = {
+            "top": {"cause": "phase:probe", "score": 0.9,
+                    "verdict": "tick probe-bound", "knob": "raise X"},
+            "ranked": [], "window_s": 60.0,
+        }
+        plane._publish_top_change()
+        plane._publish_top_change()  # unchanged cause: no second event
+        spans, _ = tracer.buffer.since(0, limit=100000)
+    finally:
+        obs.shutdown()
+    events = [s for s in spans if s["name"] == "bottleneck/top"]
+    assert len(events) == 1
+    (ev,) = events
+    validate_span(ev)
+    assert ev["startTimeUnixNano"] == ev["endTimeUnixNano"]
+    attrs = {a["key"]: a["value"] for a in ev["attributes"]}
+    assert attrs["pathway.cause"] == {"stringValue": "phase:probe"}
+    assert attrs["pathway.verdict"] == {"stringValue": "tick probe-bound"}
+    assert attrs["pathway.knob"] == {"stringValue": "raise X"}
+    assert attrs["pathway.score"] == {"doubleValue": 0.9}
